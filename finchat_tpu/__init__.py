@@ -1,0 +1,34 @@
+"""finchat_tpu — a TPU-native streaming RAG agent framework.
+
+A ground-up JAX/XLA/Pallas rebuild of the capabilities of
+``kyshu11027/financial-chatbot-llm`` (the Kafka-driven "Penny" financial
+chatbot): the external Gemini/OpenAI API calls in the reference
+(``llm_agent.py:34-45``, ``tools/qdrant_tool.py:28``) are replaced by an
+in-tree TPU inference stack — a pjit'd autoregressive decode engine with
+Pallas flash/paged attention, a paged KV cache, a continuous-batching
+scheduler fed by the Kafka consumer, and a TPU-batched embedding encoder
+backing an on-device vector index.
+
+Subpackages
+-----------
+- ``utils``    config (env-compatible with reference ``config.py``), logging,
+               metrics, tracing.
+- ``io``       message transport (Kafka semantics) + document store (Mongo
+               semantics) + wire schemas (reference ``main.py:86-121``).
+- ``models``   Llama-family decoder and BERT-family encoder in pure JAX.
+- ``ops``      Pallas TPU kernels (flash attention, paged decode attention,
+               ring attention) with jnp reference implementations.
+- ``parallel`` device mesh construction, sharding rules, multi-host init.
+- ``engine``   paged KV cache, sampler, prefill/decode step functions,
+               continuous-batching scheduler, streaming generators.
+- ``embed``    TPU-batched embedding encoder + on-device vector index.
+- ``agent``    the 3-node agent graph (decide → retrieve → generate) and the
+               streaming event protocol (reference ``llm_agent.py:57-79``).
+- ``tools``    retrieve_transactions + create_financial_plot.
+- ``serve``    stdlib asyncio HTTP server (/health, /chat, /metrics) and the
+               Kafka worker loop (reference ``main.py``).
+- ``checkpoints`` HF safetensors → sharded jax params.
+- ``train``    training step (CE loss + optax) sharded over the same mesh.
+"""
+
+__version__ = "0.1.0"

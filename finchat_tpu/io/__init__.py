@@ -1,0 +1,22 @@
+from finchat_tpu.io.schemas import (
+    ChatMessage,
+    complete_chunk,
+    error_chunk,
+    response_chunk,
+    timeout_chunk,
+)
+from finchat_tpu.io.kafka import InMemoryBroker, KafkaClient
+from finchat_tpu.io.store import ConversationStore, InMemoryStore, render_context
+
+__all__ = [
+    "ChatMessage",
+    "response_chunk",
+    "complete_chunk",
+    "error_chunk",
+    "timeout_chunk",
+    "KafkaClient",
+    "InMemoryBroker",
+    "ConversationStore",
+    "InMemoryStore",
+    "render_context",
+]

@@ -1,0 +1,288 @@
+"""Kafka transport.
+
+Same client API surface as the reference (``kafka_client.py:12-61``):
+``setup_consumer`` / ``produce_message`` / ``produce_error_message`` /
+``poll_message`` / ``close``, with the same QoS split — normal chunks are
+fire-and-forget, error chunks are flushed (kafka_client.py:26-27 vs :35-36) —
+and the same consumer settings (45 s session timeout, ``latest`` offset
+reset, group ``message_consumer``).
+
+Two backends:
+
+- ``InMemoryBroker``: an in-process broker with real Kafka semantics —
+  partitions, key → partition hashing (so a conversation's chunks stay
+  ordered, reference main.py:96), consumer groups with partition assignment
+  and committed offsets. Default when librdkafka isn't installed; also the
+  test/fault-injection harness (SURVEY §5.3: the reference has no fault
+  injection — this adds drop/delay/poison hooks).
+- confluent-kafka (librdkafka), used when ``kafka.backend == "confluent"``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import zlib
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from finchat_tpu.utils.config import GROUP_ID, USER_MESSAGE_TOPIC, KafkaConfig
+from finchat_tpu.utils.logging import get_logger
+from finchat_tpu.utils.metrics import METRICS
+
+logger = get_logger(__name__)
+
+try:  # optional native backend
+    import confluent_kafka  # type: ignore
+
+    HAVE_CONFLUENT = True
+except ImportError:  # pragma: no cover - depends on image
+    confluent_kafka = None
+    HAVE_CONFLUENT = False
+
+
+class Message:
+    """Consumer record with the confluent-kafka ``Message`` read surface the
+    app uses: ``value()`` / ``key()`` / ``topic()`` / ``error()``."""
+
+    def __init__(self, topic: str, key: str | None, value: bytes, offset: int = -1, partition: int = 0):
+        self._topic = topic
+        self._key = key
+        self._value = value
+        self._offset = offset
+        self._partition = partition
+
+    def value(self) -> bytes:
+        return self._value
+
+    def key(self) -> bytes | None:
+        # bytes, matching librdkafka's Message.key(), so code developed
+        # against the memory backend behaves identically on confluent.
+        return self._key.encode() if isinstance(self._key, str) else self._key
+
+    def topic(self) -> str:
+        return self._topic
+
+    def offset(self) -> int:
+        return self._offset
+
+    def partition(self) -> int:
+        return self._partition
+
+    def error(self) -> None:
+        return None
+
+
+@dataclass
+class FaultInjection:
+    """Test-harness fault hooks (no reference counterpart; SURVEY §5.3)."""
+
+    drop_produce: Callable[[str, dict[str, Any]], bool] | None = None
+    poison_produce: Callable[[str, bytes], bytes] | None = None
+
+
+class _PartitionLog:
+    def __init__(self) -> None:
+        self.records: list[Message] = []
+
+
+class _GroupState:
+    def __init__(self) -> None:
+        self.members: list[str] = []
+        self.offsets: dict[tuple[str, int], int] = {}  # (topic, partition) -> next offset
+
+
+class InMemoryBroker:
+    """In-process broker: topics × partitions, consumer groups, committed
+    offsets. Thread-safe; shared by all clients in a process."""
+
+    def __init__(self, num_partitions: int = 4):
+        self.num_partitions = num_partitions
+        self._lock = threading.Lock()
+        self._topics: dict[str, list[_PartitionLog]] = {}
+        self._groups: dict[str, _GroupState] = {}
+        self.faults = FaultInjection()
+
+    def _partition_for(self, key: str | None) -> int:
+        if key is None:
+            return 0
+        return zlib.crc32(key.encode()) % self.num_partitions
+
+    def _ensure_topic(self, topic: str) -> list[_PartitionLog]:
+        if topic not in self._topics:
+            self._topics[topic] = [_PartitionLog() for _ in range(self.num_partitions)]
+        return self._topics[topic]
+
+    def produce(self, topic: str, key: str | None, value: bytes) -> None:
+        with self._lock:
+            logs = self._ensure_topic(topic)
+            part = self._partition_for(key)
+            log = logs[part]
+            log.records.append(Message(topic, key, value, offset=len(log.records), partition=part))
+
+    def join_group(self, group_id: str, member_id: str, topics: list[str], offset_reset: str) -> None:
+        with self._lock:
+            group = self._groups.setdefault(group_id, _GroupState())
+            if member_id not in group.members:
+                group.members.append(member_id)
+            for topic in topics:
+                logs = self._ensure_topic(topic)
+                for part, log in enumerate(logs):
+                    tp = (topic, part)
+                    if tp not in group.offsets:
+                        group.offsets[tp] = len(log.records) if offset_reset == "latest" else 0
+
+    def leave_group(self, group_id: str, member_id: str) -> None:
+        with self._lock:
+            group = self._groups.get(group_id)
+            if group and member_id in group.members:
+                group.members.remove(member_id)
+
+    def _assignment(self, group: _GroupState, member_id: str, topics: list[str]) -> list[tuple[str, int]]:
+        """Round-robin partition assignment across live group members."""
+        idx = group.members.index(member_id)
+        n = len(group.members)
+        out = []
+        for topic in topics:
+            for part in range(self.num_partitions):
+                if part % n == idx:
+                    out.append((topic, part))
+        return out
+
+    def poll(self, group_id: str, member_id: str, topics: list[str]) -> Message | None:
+        with self._lock:
+            group = self._groups.get(group_id)
+            if group is None or member_id not in group.members:
+                return None
+            for topic, part in self._assignment(group, member_id, topics):
+                log = self._topics[topic][part]
+                offset = group.offsets.get((topic, part), 0)
+                if offset < len(log.records):
+                    group.offsets[(topic, part)] = offset + 1  # auto-commit (at-most-once)
+                    return log.records[offset]
+            return None
+
+    # --- test/introspection helpers -------------------------------------
+    def drain(self, topic: str) -> list[Message]:
+        """Read every record on a topic (all partitions, produce order per
+        partition). Test-only helper."""
+        with self._lock:
+            logs = self._topics.get(topic, [])
+            return [rec for log in logs for rec in log.records]
+
+
+_PROCESS_BROKER: InMemoryBroker | None = None
+
+
+def default_broker() -> InMemoryBroker:
+    """Process-wide shared broker for the memory backend, so independently
+    constructed producers and consumers in one process see each other."""
+    global _PROCESS_BROKER
+    if _PROCESS_BROKER is None:
+        _PROCESS_BROKER = InMemoryBroker()
+    return _PROCESS_BROKER
+
+
+class KafkaClient:
+    """Reference-compatible client (kafka_client.py) over either backend."""
+
+    _member_counter = 0
+
+    def __init__(self, config: KafkaConfig | None = None, broker: InMemoryBroker | None = None):
+        self.config = config or KafkaConfig()
+        self._consumer_ready = False
+        self._topics: list[str] = []
+        KafkaClient._member_counter += 1
+        self._member_id = f"member-{KafkaClient._member_counter}"
+
+        if self.config.backend == "confluent":
+            if not HAVE_CONFLUENT:
+                raise RuntimeError("kafka.backend=confluent but confluent-kafka is not installed")
+            self._broker = None
+            self._producer = confluent_kafka.Producer(self.config.librdkafka_config())
+            self._consumer = None
+        else:
+            self._broker = broker or default_broker()
+            self._producer = None
+            self._consumer = None
+
+    # --- consumer -------------------------------------------------------
+    def setup_consumer(self, topics: list[str] | None = None) -> None:
+        self._topics = topics or [USER_MESSAGE_TOPIC]
+        if self._broker is not None:
+            self._broker.join_group(GROUP_ID, self._member_id, self._topics, self.config.auto_offset_reset)
+        else:  # pragma: no cover - needs librdkafka
+            consumer_config = {
+                **self.config.librdkafka_config(),
+                "session.timeout.ms": str(self.config.session_timeout_ms),
+                "client.id": self.config.client_id,
+                "group.id": GROUP_ID,
+                "auto.offset.reset": self.config.auto_offset_reset,
+            }
+            self._consumer = confluent_kafka.Consumer(consumer_config)
+            self._consumer.subscribe(self._topics)
+        self._consumer_ready = True
+        logger.info("Kafka consumer started, waiting for messages...")
+
+    def poll_message(self) -> Message | None:
+        if not self._consumer_ready:
+            logger.error("Kafka consumer is not initialized.")
+            return None
+        try:
+            if self._broker is not None:
+                return self._broker.poll(GROUP_ID, self._member_id, self._topics)
+            msg = self._consumer.poll(0.1)  # pragma: no cover
+            if msg is None or msg.error():
+                if msg is not None:
+                    logger.error("Consumer error: %s", msg.error())
+                return None
+            return msg
+        except Exception as e:
+            logger.error("Error in message consumption: %s", e)
+            return None
+
+    # --- producer -------------------------------------------------------
+    def _produce_raw(self, topic: str, key: str, value: dict[str, Any]) -> None:
+        payload = json.dumps(value).encode()
+        if self._broker is not None:
+            faults = self._broker.faults
+            if faults.drop_produce and faults.drop_produce(topic, value):
+                logger.warning("fault injection: dropped produce to %s", topic)
+                return
+            if faults.poison_produce:
+                payload = faults.poison_produce(topic, payload)
+            self._broker.produce(topic, key, payload)
+        else:  # pragma: no cover
+            self._producer.produce(topic, key=key, value=payload)
+
+    def produce_message(self, topic: str, key: str, value: dict[str, Any]) -> None:
+        """Fire-and-forget produce (reference kafka_client.py:24-31)."""
+        try:
+            self._produce_raw(topic, key, value)
+            if self._producer is not None:  # pragma: no cover
+                self._producer.poll(0)
+            METRICS.inc("finchat_kafka_produced_total")
+            logger.debug("Queued message to Kafka topic %s", topic)
+        except Exception as e:
+            logger.error("Error producing message to Kafka: %s", e)
+            raise
+
+    def produce_error_message(self, topic: str, key: str, value: dict[str, Any]) -> None:
+        """Flushed produce — error delivery is guaranteed (kafka_client.py:33-40)."""
+        try:
+            self._produce_raw(topic, key, value)
+            if self._producer is not None:  # pragma: no cover
+                self._producer.flush()
+            METRICS.inc("finchat_kafka_errors_produced_total")
+            logger.debug("Queued error message to Kafka topic %s", topic)
+        except Exception as e:
+            logger.error("Failed to send error message to Kafka: %s", e)
+            raise
+
+    def close(self) -> None:
+        if self._broker is not None and self._consumer_ready:
+            self._broker.leave_group(GROUP_ID, self._member_id)
+        if self._consumer is not None:  # pragma: no cover
+            self._consumer.close()
+        if self._producer is not None:  # pragma: no cover
+            self._producer.flush()

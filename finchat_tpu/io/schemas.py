@@ -1,0 +1,88 @@
+"""Wire schemas — the product-compatibility contract.
+
+These shapes must match the reference byte-for-byte (SURVEY §2.4):
+
+- inbound ``user_message`` payload: ``{"message": ..., "conversation_id": ...,
+  **passthrough}`` (reference main.py:57-60); every inbound field is spread
+  back into every outbound chunk (main.py:86-93).
+- outbound ``ai_response`` chunk (main.py:86-96), completion marker
+  (main.py:101-108; note: no ``message`` override — it carries the original
+  user text), error marker (main.py:114-121; note: NO ``type`` field), and
+  timeout marker (main.py:144-150).
+- chat-history records: ``sender`` is ``"UserMessage"`` or ``"AIMessage"``
+  (database.py:84-87,95-101).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+USER_SENDER = "UserMessage"
+AI_SENDER = "AIMessage"
+
+TIMEOUT_TEXT = "Request timed out. Please try again."
+
+
+@dataclass
+class ChatMessage:
+    """One turn of conversation history (replaces langchain Human/AIMessage)."""
+
+    sender: str  # USER_SENDER | AI_SENDER
+    message: str
+    user_id: str = ""
+    conversation_id: str = ""
+    timestamp: int = field(default_factory=lambda: int(time.time()))
+
+    @property
+    def is_user(self) -> bool:
+        return self.sender == USER_SENDER
+
+
+def response_chunk(message_value: dict[str, Any], chunk_text: str) -> dict[str, Any]:
+    """Outbound streaming chunk (reference main.py:86-93)."""
+    return {
+        **message_value,
+        "message": chunk_text,
+        "last_message": False,
+        "error": False,
+        "sender": AI_SENDER,
+        "type": "response_chunk",
+    }
+
+
+def complete_chunk(message_value: dict[str, Any]) -> dict[str, Any]:
+    """Completion marker (reference main.py:101-107). ``message`` is NOT
+    overridden: it still carries the original inbound user text."""
+    return {
+        **message_value,
+        "last_message": True,
+        "error": False,
+        "sender": AI_SENDER,
+        "type": "complete",
+    }
+
+
+def error_chunk(message_value: dict[str, Any]) -> dict[str, Any]:
+    """Error marker (reference main.py:114-120). Intentionally has NO
+    ``type`` field and an empty ``message``."""
+    return {
+        **message_value,
+        "message": "",
+        "last_message": True,
+        "error": True,
+        "sender": AI_SENDER,
+    }
+
+
+def timeout_chunk(message_value: dict[str, Any]) -> dict[str, Any]:
+    """Watchdog-timeout marker (reference main.py:144-150). Like the error
+    marker but with the fixed user-visible text."""
+    return {
+        **message_value,
+        "message": TIMEOUT_TEXT,
+        "last_message": True,
+        "error": True,
+        "sender": AI_SENDER,
+    }

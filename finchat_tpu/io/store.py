@@ -1,0 +1,230 @@
+"""Conversation store (Mongo semantics).
+
+Preserves the reference's data model and behavior (``database.py``):
+
+- db ``conversations``, collections ``contexts`` / ``messages``
+  (database.py:11-13, config.py:32-33).
+- ``get_context`` renders the context doc into the exact first-person
+  natural-language block of database.py:56-68 and returns
+  ``(context, user_id)``; missing doc or missing user_id raises
+  (database.py:26-31).
+- ``get_history`` returns turns sorted by ascending timestamp and RAISES if
+  empty (database.py:77-79) — first-turn-with-no-history is a hard error
+  path upstream (the app writes the user message before publishing to
+  Kafka).
+- ``save_ai_message`` inserts ``{conversation_id, sender: "AIMessage",
+  user_id, message, timestamp:int}`` (database.py:95-101).
+
+Backends: ``InMemoryStore`` (in-process, honest-async) and ``MongoStore``
+(motor-less: pymongo run in a thread executor so the event loop never blocks
+— fixing the reference's sync-in-async hazard, SURVEY §2.3).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Protocol  # noqa: F401  (asyncio used by MongoStore)
+
+from finchat_tpu.io.schemas import AI_SENDER, USER_SENDER, ChatMessage
+from finchat_tpu.utils.config import (
+    CONTEXT_COLLECTION_NAME,
+    MESSAGE_COLLECTION_NAME,
+    StoreConfig,
+)
+from finchat_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+try:  # optional backend
+    import pymongo  # type: ignore
+    import certifi  # type: ignore
+
+    HAVE_PYMONGO = True
+except ImportError:  # pragma: no cover - depends on image
+    pymongo = None
+    HAVE_PYMONGO = False
+
+
+def render_context(context_doc: dict[str, Any]) -> str:
+    """Render a context document to the user-context block.
+
+    Byte-for-byte the format of reference database.py:56-68, including the
+    account normalization defaults of database.py:34-53.
+    """
+    accounts = []
+    for a in context_doc.get("accounts") or []:
+        balance = a.get("balances", {}) or {}
+        accounts.append(
+            {
+                "official_name": a.get("official_name", "Unnamed Account"),
+                "current": balance.get("current", 0.0),
+                "iso_currency_code": balance.get("iso_currency_code", ""),
+            }
+        )
+
+    context = (
+        f"My name is {context_doc['name']}.\n"
+        f"I make {context_doc['income']} dollars a month.\n"
+        f"I want to save {context_doc['savings_goal']} a month.\n\n"
+    )
+
+    context += "Here is a list of my current account balances:\n"
+    for account in accounts:
+        context += f"{account['official_name']} : {account['current']} {account['iso_currency_code']}\n"
+
+    context += "Here is a list of my recurring monthly expenses:\n"
+    for expense in context_doc.get("additional_monthly_expenses") or []:
+        context += f"Name: {expense['name']} | Amount: {expense['amount']}"
+        if expense["description"] != "":
+            context += f" | Description: {expense['description']}"
+        context += "\n"
+
+    return context
+
+
+class ConversationStore(Protocol):
+    async def check_connection(self) -> None: ...
+
+    async def get_context(self, conversation_id: str) -> tuple[str, str]: ...
+
+    async def get_history(self, conversation_id: str) -> list[ChatMessage]: ...
+
+    async def save_ai_message(self, conversation_id: str, message: str, user_id: str) -> None: ...
+
+
+class InMemoryStore:
+    """In-process store with the Mongo-backed behavior above. Also the test
+    fixture surface: ``upsert_context`` / ``add_user_message`` seed state."""
+
+    def __init__(self, config: StoreConfig | None = None):
+        self.config = config or StoreConfig()
+        # Single-threaded event-loop access; no await inside any mutation,
+        # so no lock is needed (and none is pretended).
+        self._contexts: dict[str, dict[str, Any]] = {}
+        self._messages: list[dict[str, Any]] = []
+
+    async def check_connection(self) -> None:
+        logger.info("In-memory store ready")
+
+    async def get_context(self, conversation_id: str) -> tuple[str, str]:
+        context_doc = self._contexts.get(conversation_id)
+        if not context_doc:
+            raise LookupError(f"No context found for conversation_id: {conversation_id}")
+        user_id = context_doc.get("user_id", "")
+        if not user_id:
+            raise LookupError(f"No user_id found in context for conversation_id: {conversation_id}")
+        return render_context(context_doc), user_id
+
+    async def get_history(self, conversation_id: str) -> list[ChatMessage]:
+        rows = sorted(
+            (m for m in self._messages if m["conversation_id"] == conversation_id),
+            key=lambda m: m["timestamp"],
+        )
+        if not rows:
+            raise LookupError(f"No chat history found for conversation_id: {conversation_id}")
+        return [
+            ChatMessage(
+                sender=m["sender"],
+                message=m["message"],
+                user_id=m.get("user_id", ""),
+                conversation_id=conversation_id,
+                timestamp=m["timestamp"],
+            )
+            for m in rows
+        ]
+
+    async def save_ai_message(self, conversation_id: str, message: str, user_id: str) -> None:
+        self._messages.append(
+            {
+                "conversation_id": conversation_id,
+                "sender": AI_SENDER,
+                "user_id": user_id,
+                "message": message,
+                "timestamp": int(time.time()),
+            }
+        )
+
+    # --- seeding helpers (used by tests and the dev harness) -------------
+    def upsert_context(self, conversation_id: str, context_doc: dict[str, Any]) -> None:
+        self._contexts[conversation_id] = {"conversation_id": conversation_id, **context_doc}
+
+    def add_user_message(self, conversation_id: str, message: str, user_id: str, timestamp: int | None = None) -> None:
+        self._messages.append(
+            {
+                "conversation_id": conversation_id,
+                "sender": USER_SENDER,
+                "user_id": user_id,
+                "message": message,
+                "timestamp": int(time.time()) if timestamp is None else timestamp,
+            }
+        )
+
+
+class MongoStore:
+    """pymongo-backed store. All blocking driver calls run in the default
+    thread executor, keeping the event loop honest (the reference calls sync
+    pymongo directly inside ``async def`` — database.py:25,77,95)."""
+
+    def __init__(self, config: StoreConfig):
+        if not HAVE_PYMONGO:  # pragma: no cover
+            raise RuntimeError("store.backend=mongo but pymongo is not installed")
+        self.config = config
+        self._client = pymongo.MongoClient(config.mongodb_uri, tls=True, tlsCAFile=certifi.where())
+        db = self._client[config.database_name]
+        self._contexts = db[CONTEXT_COLLECTION_NAME]
+        self._messages = db[MESSAGE_COLLECTION_NAME]
+
+    async def _run(self, fn, *args):
+        return await asyncio.get_running_loop().run_in_executor(None, fn, *args)
+
+    async def check_connection(self) -> None:
+        try:
+            await self._run(self._client.admin.command, "ping")
+            logger.info("MongoDB connection successful!")
+        except Exception as e:
+            logger.error("MongoDB connection failed: %s", e)
+            raise RuntimeError(f"MongoDB connection failed: {e}") from e
+
+    async def get_context(self, conversation_id: str) -> tuple[str, str]:
+        context_doc = await self._run(self._contexts.find_one, {"conversation_id": conversation_id})
+        if not context_doc:
+            raise LookupError(f"No context found for conversation_id: {conversation_id}")
+        user_id = context_doc.get("user_id", "")
+        if not user_id:
+            raise LookupError(f"No user_id found in context for conversation_id: {conversation_id}")
+        return render_context(context_doc), user_id
+
+    async def get_history(self, conversation_id: str) -> list[ChatMessage]:
+        def _fetch():
+            return list(self._messages.find({"conversation_id": conversation_id}).sort("timestamp", 1))
+
+        rows = await self._run(_fetch)
+        if not rows:
+            raise LookupError(f"No chat history found for conversation_id: {conversation_id}")
+        return [
+            ChatMessage(
+                sender=m["sender"],
+                message=m["message"],
+                user_id=m.get("user_id", ""),
+                conversation_id=conversation_id,
+                timestamp=m["timestamp"],
+            )
+            for m in rows
+        ]
+
+    async def save_ai_message(self, conversation_id: str, message: str, user_id: str) -> None:
+        doc = {
+            "conversation_id": conversation_id,
+            "sender": AI_SENDER,
+            "user_id": user_id,
+            "message": message,
+            "timestamp": int(time.time()),
+        }
+        await self._run(self._messages.insert_one, doc)
+
+
+def make_store(config: StoreConfig) -> ConversationStore:
+    if config.backend == "mongo":
+        return MongoStore(config)
+    return InMemoryStore(config)

@@ -1,0 +1,4 @@
+from finchat_tpu.utils.logging import get_logger
+from finchat_tpu.utils.config import AppConfig, load_config
+
+__all__ = ["get_logger", "AppConfig", "load_config"]

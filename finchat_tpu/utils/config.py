@@ -1,0 +1,232 @@
+"""Typed configuration tree.
+
+Drop-in env compatibility with the reference's ``config.py:8-47`` — every
+env-var name the reference reads keeps working here — plus the sections the
+reference has no counterpart for (model, mesh, engine, scheduler), which are
+new TPU-framework surface.
+
+Hardcoded constants preserved from the reference:
+  topics ``user_message`` / ``ai_response`` (config.py:26-27), consumer group
+  ``message_consumer`` (config.py:28), Mongo collections ``contexts`` /
+  ``messages`` (config.py:32-33), vector collection ``transactions``
+  (config.py:47).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any
+
+# ---------------------------------------------------------------------------
+# Constants that are part of the product contract (not configurable in the
+# reference either).
+# ---------------------------------------------------------------------------
+USER_MESSAGE_TOPIC = "user_message"
+AI_RESPONSE_TOPIC = "ai_response"
+GROUP_ID = "message_consumer"
+CONTEXT_COLLECTION_NAME = "contexts"
+MESSAGE_COLLECTION_NAME = "messages"
+TRANSACTION_COLLECTION_NAME = "transactions"
+
+
+def _env(name: str, default: str = "") -> str:
+    return os.getenv(name, default)
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.getenv(name)
+    if raw is None or raw == "":
+        return default
+    return int(raw)
+
+
+@dataclass
+class KafkaConfig:
+    """Transport settings; mirrors reference ``config.py:8-28``."""
+
+    bootstrap_servers: str = ""
+    username: str = ""
+    password: str = ""
+    session_timeout_ms: int = 45_000
+    client_id: str = "python-client-1"
+    auto_offset_reset: str = "latest"
+    # "memory" = in-process broker (tests/dev); "confluent" = librdkafka.
+    backend: str = "memory"
+
+    def librdkafka_config(self) -> dict[str, str]:
+        """Render the confluent-kafka config dict, including the SASL_SSL ↔
+        PLAINTEXT switch the reference performs (config.py:15-23)."""
+        cfg: dict[str, str] = {"bootstrap.servers": self.bootstrap_servers}
+        if self.username and self.password:
+            cfg.update(
+                {
+                    "security.protocol": "SASL_SSL",
+                    "sasl.mechanisms": "PLAIN",
+                    "sasl.username": self.username,
+                    "sasl.password": self.password,
+                }
+            )
+        else:
+            cfg["security.protocol"] = "PLAINTEXT"
+        return cfg
+
+
+@dataclass
+class StoreConfig:
+    """Conversation store; mirrors reference Mongo usage (``database.py``)."""
+
+    mongodb_uri: str = ""
+    database_name: str = "conversations"
+    # "memory" = in-process store; "mongo" = pymongo (requires the wheel).
+    backend: str = "memory"
+
+
+@dataclass
+class VectorConfig:
+    """Vector index over user transactions.
+
+    The reference delegates to a remote Qdrant (``tools/qdrant_tool.py``);
+    here the default backend is the in-tree on-device index with brute-force
+    exact cosine search on the MXU. ``hnsw_ef`` is kept for the optional
+    qdrant backend's parity (reference qdrant_tool.py:99).
+    """
+
+    url: str = ""
+    api_key: str = ""
+    collection: str = TRANSACTION_COLLECTION_NAME
+    hnsw_ef: int = 128
+    default_limit: int = 10_000  # reference qdrant_tool.py:145
+    backend: str = "device"  # "device" | "qdrant"
+
+
+@dataclass
+class ModelConfig:
+    """Which decoder to serve and how to load it (no reference counterpart)."""
+
+    preset: str = "tiny"  # see models/llama.py PRESETS
+    checkpoint_path: str = ""  # HF safetensors dir; empty = random init
+    tokenizer_path: str = ""  # HF tokenizer dir; empty = byte tokenizer
+    dtype: str = "bfloat16"
+    seed: int = 0
+
+
+@dataclass
+class MeshConfig:
+    """Device mesh axes (no reference counterpart — reference has no devices).
+
+    Axis names follow the scaling-book convention: ``data`` (DP/batch),
+    ``model`` (TP), ``seq`` (SP/ring attention), ``expert`` (EP). A size of
+    -1 means "absorb all remaining devices".
+    """
+
+    data: int = 1
+    model: int = -1
+    seq: int = 1
+    expert: int = 1
+
+
+@dataclass
+class EngineConfig:
+    """Inference engine + continuous-batching scheduler settings."""
+
+    max_seqs: int = 64  # concurrent sequences (BASELINE north star)
+    page_size: int = 128  # tokens per KV page
+    num_pages: int = 512  # total pages in the paged KV cache
+    max_seq_len: int = 8192
+    prefill_chunk: int = 512  # chunked prefill granularity
+    max_new_tokens: int = 1024
+    temperature: float = 0.5  # parity with reference llm_agent.py:37,44
+    top_p: float = 1.0
+    top_k: int = 0
+    watchdog_seconds: float = 100.0  # reference main.py:138
+    stream_flush_tokens: int = 1  # tokens per outbound chunk
+
+
+@dataclass
+class EmbedConfig:
+    """TPU embedding encoder (replaces OpenAI embeddings API)."""
+
+    preset: str = "bge-tiny"  # see models/bert.py PRESETS
+    checkpoint_path: str = ""
+    batch_size: int = 64
+    dim: int = 384
+
+
+@dataclass
+class ServeConfig:
+    host: str = "0.0.0.0"
+    port: int = 8000
+
+
+@dataclass
+class AppConfig:
+    kafka: KafkaConfig = field(default_factory=KafkaConfig)
+    store: StoreConfig = field(default_factory=StoreConfig)
+    vector: VectorConfig = field(default_factory=VectorConfig)
+    model: ModelConfig = field(default_factory=ModelConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    engine: EngineConfig = field(default_factory=EngineConfig)
+    embed: EmbedConfig = field(default_factory=EmbedConfig)
+    serve: ServeConfig = field(default_factory=ServeConfig)
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def _apply_overrides(cfg: Any, overrides: dict[str, Any]) -> None:
+    """Apply a {"section.key": value} or nested-dict override mapping."""
+    for key, value in overrides.items():
+        node = cfg
+        parts = key.split(".")
+        for part in parts[:-1]:
+            node = getattr(node, part)
+        leaf = parts[-1]
+        if not hasattr(node, leaf):
+            raise KeyError(f"unknown config key: {key!r}")
+        if isinstance(value, dict) and dataclasses.is_dataclass(getattr(node, leaf)):
+            _apply_overrides(getattr(node, leaf), {k: v for k, v in value.items()})
+        else:
+            setattr(node, leaf, value)
+
+
+def load_config(
+    config_file: str | None = None, overrides: dict[str, Any] | None = None
+) -> AppConfig:
+    """Build the config tree: defaults ← env vars ← JSON file ← overrides.
+
+    Env names match the reference (``config.py:8-47``) so a reference
+    deployment's ``.env`` drops in unchanged.
+    """
+    cfg = AppConfig()
+
+    # --- env (reference-compatible names) ---
+    cfg.kafka.bootstrap_servers = _env("KAFKA_SERVER")
+    cfg.kafka.username = _env("KAFKA_USERNAME")
+    cfg.kafka.password = _env("KAFKA_PASSWORD")
+    cfg.store.mongodb_uri = _env("MONGODB_URI")
+    cfg.vector.url = _env("QDRANT_URL")
+    cfg.vector.api_key = _env("QDRANT_API_KEY")
+
+    # --- env (new framework surface) ---
+    cfg.kafka.backend = _env("FINCHAT_KAFKA_BACKEND", cfg.kafka.backend)
+    cfg.store.backend = _env("FINCHAT_STORE_BACKEND", cfg.store.backend)
+    cfg.vector.backend = _env("FINCHAT_VECTOR_BACKEND", cfg.vector.backend)
+    cfg.model.preset = _env("FINCHAT_MODEL_PRESET", cfg.model.preset)
+    cfg.model.checkpoint_path = _env("FINCHAT_CHECKPOINT", cfg.model.checkpoint_path)
+    cfg.model.tokenizer_path = _env("FINCHAT_TOKENIZER", cfg.model.tokenizer_path)
+    cfg.engine.max_seqs = _env_int("FINCHAT_MAX_SEQS", cfg.engine.max_seqs)
+    cfg.serve.port = _env_int("FINCHAT_PORT", cfg.serve.port)
+
+    # --- optional JSON config file ---
+    if config_file:
+        with open(config_file) as f:
+            _apply_overrides(cfg, json.load(f))
+
+    # --- explicit overrides win ---
+    if overrides:
+        _apply_overrides(cfg, overrides)
+
+    return cfg

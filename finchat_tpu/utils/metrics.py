@@ -1,0 +1,140 @@
+"""Metrics registry.
+
+The reference has no metrics (SURVEY §5.5); these counters ARE the product's
+north-star surface (tok/s/chip, TTFT, queue depth, batch occupancy, KV-page
+utilization), exported in Prometheus text format at ``/metrics``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+
+@dataclass
+class _Histogram:
+    """Fixed-bucket histogram (seconds-scale by default)."""
+
+    buckets: tuple[float, ...] = (
+        0.005, 0.01, 0.025, 0.05, 0.1, 0.2, 0.3, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 100.0,
+    )
+    counts: list[int] = field(default_factory=list)
+    total: float = 0.0
+    n: int = 0
+
+    def __post_init__(self) -> None:
+        if not self.counts:
+            self.counts = [0] * (len(self.buckets) + 1)
+
+    def observe(self, value: float) -> None:
+        self.total += value
+        self.n += 1
+        for i, edge in enumerate(self.buckets):
+            if value <= edge:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+    def quantile(self, q: float) -> float:
+        """Approximate quantile from bucket edges (upper bound of the bucket)."""
+        if self.n == 0:
+            return 0.0
+        target = q * self.n
+        seen = 0
+        for i, edge in enumerate(self.buckets):
+            seen += self.counts[i]
+            if seen >= target:
+                return edge
+        return float("inf")
+
+
+class MetricsRegistry:
+    """Thread-safe counters / gauges / histograms with Prometheus rendering."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: dict[str, float] = defaultdict(float)
+        self._gauges: dict[str, float] = {}
+        self._histograms: dict[str, _Histogram] = {}
+
+    def inc(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] += value
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def observe(self, name: str, value: float) -> None:
+        with self._lock:
+            if name not in self._histograms:
+                self._histograms[name] = _Histogram()
+            self._histograms[name].observe(value)
+
+    def get(self, name: str) -> float:
+        with self._lock:
+            if name in self._counters:
+                return self._counters[name]
+            return self._gauges.get(name, 0.0)
+
+    def quantile(self, name: str, q: float) -> float:
+        with self._lock:
+            hist = self._histograms.get(name)
+            return hist.quantile(q) if hist else 0.0
+
+    def snapshot(self) -> dict[str, float]:
+        with self._lock:
+            snap = dict(self._counters)
+            snap.update(self._gauges)
+            for name, h in self._histograms.items():
+                snap[f"{name}_count"] = h.n
+                snap[f"{name}_sum"] = h.total
+                if h.n:
+                    snap[f"{name}_p50"] = h.quantile(0.50)
+                    snap[f"{name}_p95"] = h.quantile(0.95)
+            return snap
+
+    def render_prometheus(self) -> str:
+        lines: list[str] = []
+        with self._lock:
+            for name, value in sorted(self._counters.items()):
+                lines.append(f"# TYPE {name} counter")
+                lines.append(f"{name} {value}")
+            for name, value in sorted(self._gauges.items()):
+                lines.append(f"# TYPE {name} gauge")
+                lines.append(f"{name} {value}")
+            for name, h in sorted(self._histograms.items()):
+                lines.append(f"# TYPE {name} histogram")
+                cumulative = 0
+                for i, edge in enumerate(h.buckets):
+                    cumulative += h.counts[i]
+                    lines.append(f'{name}_bucket{{le="{edge}"}} {cumulative}')
+                cumulative += h.counts[-1]
+                lines.append(f'{name}_bucket{{le="+Inf"}} {cumulative}')
+                lines.append(f"{name}_sum {h.total}")
+                lines.append(f"{name}_count {h.n}")
+        return "\n".join(lines) + "\n"
+
+
+# Process-global registry (one worker process = one registry, matching the
+# reference's one-logger-per-process pattern).
+METRICS = MetricsRegistry()
+
+
+class Timer:
+    """Context manager: ``with Timer(METRICS, "prefill_seconds"): ...``"""
+
+    def __init__(self, registry: MetricsRegistry, name: str) -> None:
+        self._registry = registry
+        self._name = name
+        self.elapsed = 0.0
+
+    def __enter__(self) -> "Timer":
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc: object) -> None:
+        self.elapsed = time.perf_counter() - self._start
+        self._registry.observe(self._name, self.elapsed)

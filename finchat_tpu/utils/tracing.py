@@ -1,0 +1,68 @@
+"""Request-span tracing.
+
+The reference has none (SURVEY §5.1). Two planes here:
+
+1. Host spans — per-request lifecycle timing (queue → prefill → first token →
+   done), recorded into the metrics registry and debug logs.
+2. Device traces — ``jax.profiler`` capture (TensorBoard/Perfetto dumps) and
+   ``jax.named_scope`` annotations around kernel regions, toggled at runtime.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+from typing import Iterator
+
+import jax
+
+from finchat_tpu.utils.logging import get_logger
+from finchat_tpu.utils.metrics import METRICS, MetricsRegistry
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class RequestSpan:
+    """Lifecycle timestamps for one request through the serving stack."""
+
+    request_id: str
+    created_at: float = field(default_factory=time.perf_counter)
+    marks: dict[str, float] = field(default_factory=dict)
+
+    def mark(self, name: str) -> None:
+        self.marks[name] = time.perf_counter() - self.created_at
+
+    def ttft(self) -> float | None:
+        """Time to first token, if the request got that far."""
+        return self.marks.get("first_token")
+
+    def finish(self, registry: MetricsRegistry = METRICS) -> None:
+        self.mark("done")
+        if "first_token" in self.marks:
+            registry.observe("finchat_ttft_seconds", self.marks["first_token"])
+        registry.observe("finchat_request_seconds", self.marks["done"])
+        logger.debug(
+            "span %s: %s",
+            self.request_id,
+            " ".join(f"{k}={v * 1e3:.1f}ms" for k, v in sorted(self.marks.items(), key=lambda kv: kv[1])),
+        )
+
+
+@contextlib.contextmanager
+def named_scope(name: str) -> Iterator[None]:
+    """jax.named_scope wrapper that is a no-op outside a trace."""
+    with jax.named_scope(name):
+        yield
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str) -> Iterator[None]:
+    """Capture a jax profiler trace (view in TensorBoard / Perfetto)."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        logger.info("profiler trace written to %s", log_dir)

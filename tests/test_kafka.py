@@ -1,0 +1,91 @@
+"""In-memory broker semantics: ordering by key, groups, QoS, faults."""
+
+import json
+
+from finchat_tpu.io.kafka import InMemoryBroker, KafkaClient
+from finchat_tpu.utils.config import AI_RESPONSE_TOPIC, USER_MESSAGE_TOPIC, KafkaConfig
+
+
+def _client(broker):
+    return KafkaClient(KafkaConfig(backend="memory"), broker=broker)
+
+
+def test_produce_consume_roundtrip():
+    broker = InMemoryBroker()
+    producer = _client(broker)
+    consumer = _client(broker)
+    consumer.setup_consumer([USER_MESSAGE_TOPIC])
+    # offset_reset=latest: records produced AFTER joining are visible
+    producer.produce_message(USER_MESSAGE_TOPIC, "conv-1", {"message": "hi", "conversation_id": "conv-1"})
+    msg = consumer.poll_message()
+    assert msg is not None
+    assert json.loads(msg.value().decode()) == {"message": "hi", "conversation_id": "conv-1"}
+    assert msg.key() == b"conv-1"  # bytes, matching librdkafka's Message.key()
+    assert msg.error() is None
+    assert consumer.poll_message() is None
+
+
+def test_offset_reset_latest_skips_history():
+    broker = InMemoryBroker()
+    producer = _client(broker)
+    producer.produce_message(USER_MESSAGE_TOPIC, "k", {"old": True})
+    consumer = _client(broker)
+    consumer.setup_consumer([USER_MESSAGE_TOPIC])
+    assert consumer.poll_message() is None  # auto.offset.reset=latest (kafka_client.py:18)
+
+
+def test_same_key_preserves_order():
+    broker = InMemoryBroker()
+    producer = _client(broker)
+    consumer = _client(broker)
+    consumer.setup_consumer([AI_RESPONSE_TOPIC])
+    for i in range(20):
+        producer.produce_message(AI_RESPONSE_TOPIC, "conv-A", {"i": i})
+    seen = []
+    while (msg := consumer.poll_message()) is not None:
+        seen.append(json.loads(msg.value().decode())["i"])
+    assert seen == list(range(20))
+
+
+def test_group_partition_split():
+    broker = InMemoryBroker(num_partitions=4)
+    producer = _client(broker)
+    c1, c2 = _client(broker), _client(broker)
+    c1.setup_consumer([USER_MESSAGE_TOPIC])
+    c2.setup_consumer([USER_MESSAGE_TOPIC])
+    keys = [f"conv-{i}" for i in range(40)]
+    for k in keys:
+        producer.produce_message(USER_MESSAGE_TOPIC, k, {"k": k})
+    got1, got2 = set(), set()
+    while (m := c1.poll_message()) is not None:
+        got1.add(json.loads(m.value().decode())["k"])
+    while (m := c2.poll_message()) is not None:
+        got2.add(json.loads(m.value().decode())["k"])
+    assert got1 | got2 == set(keys)
+    assert got1.isdisjoint(got2)
+    assert got1 and got2  # both members got an assignment
+
+
+def test_default_broker_is_shared_per_process():
+    # Two independently constructed clients must see each other (no silent
+    # per-client broker isolation).
+    producer = KafkaClient(KafkaConfig(backend="memory"))
+    consumer = KafkaClient(KafkaConfig(backend="memory"))
+    consumer.setup_consumer([AI_RESPONSE_TOPIC])
+    producer.produce_message(AI_RESPONSE_TOPIC, "shared", {"ok": 1})
+    msg = consumer.poll_message()
+    assert msg is not None and json.loads(msg.value().decode()) == {"ok": 1}
+    consumer.close()
+
+
+def test_fault_injection_drop():
+    broker = InMemoryBroker()
+    broker.faults.drop_produce = lambda topic, value: value.get("drop", False)
+    producer = _client(broker)
+    consumer = _client(broker)
+    consumer.setup_consumer([AI_RESPONSE_TOPIC])
+    producer.produce_message(AI_RESPONSE_TOPIC, "k", {"drop": True})
+    producer.produce_message(AI_RESPONSE_TOPIC, "k", {"drop": False})
+    msg = consumer.poll_message()
+    assert json.loads(msg.value().decode()) == {"drop": False}
+    assert consumer.poll_message() is None

@@ -1,0 +1,65 @@
+"""Lock the §2.4 wire contract byte-for-byte (reference main.py:86-150)."""
+
+import json
+
+from finchat_tpu.io.schemas import (
+    TIMEOUT_TEXT,
+    complete_chunk,
+    error_chunk,
+    response_chunk,
+    timeout_chunk,
+)
+
+INBOUND = {
+    "message": "What did I spend on groceries?",
+    "conversation_id": "conv-1",
+    "user_id": "user-9",
+    "extra_passthrough": 42,
+}
+
+
+def test_response_chunk_shape():
+    chunk = response_chunk(INBOUND, "Hello")
+    assert chunk == {
+        "message": "Hello",
+        "conversation_id": "conv-1",
+        "user_id": "user-9",
+        "extra_passthrough": 42,
+        "last_message": False,
+        "error": False,
+        "sender": "AIMessage",
+        "type": "response_chunk",
+    }
+
+
+def test_complete_chunk_keeps_original_user_text():
+    chunk = complete_chunk(INBOUND)
+    # reference main.py:101-107: no "message" override on the completion marker
+    assert chunk["message"] == "What did I spend on groceries?"
+    assert chunk["last_message"] is True
+    assert chunk["error"] is False
+    assert chunk["type"] == "complete"
+    assert chunk["sender"] == "AIMessage"
+
+
+def test_error_chunk_has_no_type_field():
+    chunk = error_chunk(INBOUND)
+    # reference main.py:114-120: error marker has empty message and NO type key
+    assert chunk["message"] == ""
+    assert chunk["last_message"] is True
+    assert chunk["error"] is True
+    assert chunk["sender"] == "AIMessage"
+    assert "type" not in chunk
+
+
+def test_timeout_chunk_text():
+    chunk = timeout_chunk(INBOUND)
+    assert chunk["message"] == TIMEOUT_TEXT == "Request timed out. Please try again."
+    assert chunk["error"] is True
+    assert chunk["last_message"] is True
+    assert "type" not in chunk
+
+
+def test_chunks_are_json_serializable():
+    for chunk in (response_chunk(INBOUND, "x"), complete_chunk(INBOUND), error_chunk(INBOUND)):
+        assert json.loads(json.dumps(chunk)) == chunk

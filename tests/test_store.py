@@ -1,0 +1,85 @@
+"""Context rendering + store behavior parity (reference database.py)."""
+
+import pytest
+
+from finchat_tpu.io.store import InMemoryStore, render_context
+
+CONTEXT_DOC = {
+    "conversation_id": "conv-1",
+    "user_id": "user-9",
+    "name": "Alex",
+    "income": 8000,
+    "savings_goal": 1500,
+    "accounts": [
+        {
+            "account_id": "a1",
+            "balances": {"available": 900.0, "current": 1234.5, "limit": None, "iso_currency_code": "USD"},
+            "mask": "1234",
+            "name": "Checking",
+            "official_name": "Plaid Gold Standard Checking",
+            "subtype": "checking",
+            "type": "depository",
+        },
+        {"balances": {}},  # exercise normalization defaults
+    ],
+    "additional_monthly_expenses": [
+        {"name": "Gym", "amount": 40, "description": ""},
+        {"name": "Rent", "amount": 2000, "description": "downtown apartment"},
+    ],
+}
+
+
+def test_render_context_exact_format():
+    # byte-for-byte the reference's format (database.py:56-68)
+    expected = (
+        "My name is Alex.\n"
+        "I make 8000 dollars a month.\n"
+        "I want to save 1500 a month.\n\n"
+        "Here is a list of my current account balances:\n"
+        "Plaid Gold Standard Checking : 1234.5 USD\n"
+        "Unnamed Account : 0.0 \n"
+        "Here is a list of my recurring monthly expenses:\n"
+        "Name: Gym | Amount: 40\n"
+        "Name: Rent | Amount: 2000 | Description: downtown apartment\n"
+    )
+    assert render_context(CONTEXT_DOC) == expected
+
+
+def test_render_context_missing_optional_sections():
+    doc = {"name": "B", "income": 1, "savings_goal": 2, "accounts": None, "additional_monthly_expenses": None}
+    out = render_context(doc)
+    assert "account balances:\nHere is a list" in out
+
+
+async def test_get_context_returns_user_id():
+    store = InMemoryStore()
+    store.upsert_context("conv-1", CONTEXT_DOC)
+    context, user_id = await store.get_context("conv-1")
+    assert user_id == "user-9"
+    assert context.startswith("My name is Alex.")
+
+
+async def test_get_context_missing_raises():
+    store = InMemoryStore()
+    with pytest.raises(LookupError):
+        await store.get_context("nope")
+
+
+async def test_get_context_missing_user_id_raises():
+    store = InMemoryStore()
+    store.upsert_context("conv-2", {**CONTEXT_DOC, "user_id": ""})
+    with pytest.raises(LookupError):
+        await store.get_context("conv-2")
+
+
+async def test_history_sorted_and_empty_raises():
+    store = InMemoryStore()
+    with pytest.raises(LookupError):
+        await store.get_history("conv-1")  # empty history is a hard error (database.py:78-79)
+
+    store.add_user_message("conv-1", "second", "user-9", timestamp=200)
+    store.add_user_message("conv-1", "first", "user-9", timestamp=100)
+    await store.save_ai_message("conv-1", "reply", "user-9")
+    history = await store.get_history("conv-1")
+    assert [m.message for m in history[:2]] == ["first", "second"]
+    assert history[0].is_user and history[-1].sender == "AIMessage"
