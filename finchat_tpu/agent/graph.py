@@ -1,0 +1,251 @@
+"""The 3-node agent graph and its streaming bypass.
+
+Native, typed replacement for the reference's LangGraph agent
+(``llm_agent.py:57-79``): decide_retrieval → (conditional) → retrieve_data →
+generate_response → END. Two execution paths, both preserved (SURVEY §2.5):
+
+- ``query()`` walks the compiled graph (reference llm_agent.py:175-200) —
+  batch, non-streaming.
+- ``stream_with_status()`` bypasses the graph and calls the node functions
+  directly so it can interleave status events and stream the final
+  generation (reference llm_agent.py:202-252). Event shapes and messages
+  are kept verbatim — they are wire contract (SURVEY §2.4).
+
+The two LLM roles of the reference (tool-decision vs response,
+llm_agent.py:34-45) become two TextGenerators — typically the same TPU
+engine with different prompts and sampling.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import replace as dc_replace
+from datetime import date
+from typing import Any, AsyncGenerator, Awaitable, Callable
+
+from finchat_tpu.agent.state import AgentState, ToolCall
+from finchat_tpu.agent.toolcall import parse_tool_decision
+from finchat_tpu.engine.generator import TextGenerator
+from finchat_tpu.engine.sampler import SamplingParams
+from finchat_tpu.io.schemas import ChatMessage
+from finchat_tpu.models.tokenizer import render_chat
+from finchat_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+END = "__end__"
+
+# async retriever: validated tool args (with server-injected user_id) -> texts
+Retriever = Callable[[dict[str, Any]], Awaitable[list[str]]]
+
+
+class StateGraph:
+    """Minimal typed state machine: named nodes, static edges, conditional
+    routing — the semantics the reference gets from langgraph's StateGraph
+    (llm_agent.py:59-79) in ~50 lines."""
+
+    def __init__(self) -> None:
+        self._nodes: dict[str, Callable[[AgentState], Awaitable[AgentState]]] = {}
+        self._edges: dict[str, str] = {}
+        self._conditional: dict[str, tuple[Callable[[AgentState], str], dict[str, str]]] = {}
+        self._entry: str | None = None
+
+    def add_node(self, name: str, fn: Callable[[AgentState], Awaitable[AgentState]]) -> None:
+        self._nodes[name] = fn
+
+    def set_entry_point(self, name: str) -> None:
+        self._entry = name
+
+    def add_edge(self, src: str, dst: str) -> None:
+        self._edges[src] = dst
+
+    def add_conditional_edges(
+        self, src: str, router: Callable[[AgentState], str], mapping: dict[str, str]
+    ) -> None:
+        self._conditional[src] = (router, mapping)
+
+    async def ainvoke(self, state: AgentState) -> AgentState:
+        assert self._entry is not None, "entry point not set"
+        node = self._entry
+        while node != END:
+            state = await self._nodes[node](state)
+            if node in self._conditional:
+                router, mapping = self._conditional[node]
+                node = mapping[router(state)]
+            else:
+                node = self._edges[node]
+        return state
+
+
+class LLMAgent:
+    def __init__(
+        self,
+        tool_generator: TextGenerator,
+        response_generator: TextGenerator,
+        retriever: Retriever,
+        system_prompt: str,
+        tool_prompt: str,
+        *,
+        tool_sampling: SamplingParams | None = None,
+        response_sampling: SamplingParams | None = None,
+        today: Callable[[], str] = lambda: date.today().isoformat(),
+    ):
+        self.tool_generator = tool_generator
+        self.response_generator = response_generator
+        self.retriever = retriever
+        self.system_prompt = system_prompt
+        self.tool_prompt = tool_prompt
+        # temperature 0.5 both roles (reference llm_agent.py:37,44); the
+        # decision head is short and greedy-leaning would also be defensible,
+        # but parity wins.
+        self.tool_sampling = tool_sampling or SamplingParams(temperature=0.5, max_new_tokens=96)
+        self.response_sampling = response_sampling or SamplingParams(temperature=0.5)
+        self.today = today
+        self.graph = self._build_graph()
+        logger.info("Agent initialized with state graph")
+
+    def _build_graph(self) -> StateGraph:
+        graph = StateGraph()
+        graph.add_node("decide_retrieval", self._decide_retrieval_node)
+        graph.add_node("retrieve_data", self._retrieve_data_node)
+        graph.add_node("generate_response", self._generate_response_node)
+        graph.set_entry_point("decide_retrieval")
+        graph.add_conditional_edges(
+            "decide_retrieval",
+            self._should_retrieve,
+            {"retrieve": "retrieve_data", "respond": "generate_response"},
+        )
+        graph.add_edge("retrieve_data", "generate_response")
+        graph.add_edge("generate_response", END)
+        return graph
+
+    # --- prompt assembly -------------------------------------------------
+    def _tool_prompt_text(self, state: AgentState) -> str:
+        system = f"The current date is {self.today()}.\n{self.tool_prompt}"
+        return render_chat(system, state.user_context, state.chat_history, state.user_query)
+
+    def _response_prompt_text(self, state: AgentState) -> str:
+        context = f"{state.user_context}\n"
+        if state.retrieved_transactions:
+            context += "Retrieved Transaction Data:\n" + "\n".join(state.retrieved_transactions)
+        system = f"The current date is {self.today()}.\n\n{self.system_prompt}"
+        return render_chat(system, context, state.chat_history, state.user_query)
+
+    # --- nodes -----------------------------------------------------------
+    async def _decide_retrieval_node(self, state: AgentState) -> AgentState:
+        """Node 1: decide whether transaction retrieval is needed."""
+        logger.info("Deciding if transaction retrieval is needed")
+        decision_text = await self.tool_generator.generate(
+            self._tool_prompt_text(state), self.tool_sampling
+        )
+        tool_call = parse_tool_decision(decision_text)
+        if tool_call is not None:
+            state.tool_calls.append(tool_call)
+            logger.info("LLM requested retrieval with args: %s", tool_call.args)
+        else:
+            logger.info("LLM decided no retrieval needed")
+        return state
+
+    async def _retrieve_data_node(self, state: AgentState) -> AgentState:
+        """Node 2: execute retrieval. Only the first queued call is honored
+        (llm_agent.py:100,116); failure degrades to an Error marker and the
+        answer is still generated (llm_agent.py:129-131)."""
+        logger.info("Retrieving transaction data")
+        if not state.tool_calls:
+            return state
+        try:
+            tool_call = state.tool_calls.popleft()
+            tool_args = dict(tool_call.args)
+            tool_args["user_id"] = state.user_id  # server-side injection, never model-chosen
+            transactions = await self.retriever(tool_args)
+            state.retrieved_transactions = transactions
+            logger.info("Retrieved %d transactions", len(transactions))
+        except Exception as e:
+            logger.error("Error retrieving transactions: %s", e)
+            state.retrieved_transactions = [f"Error: {e}"]
+        return state
+
+    async def _generate_response_node(self, state: AgentState) -> AgentState:
+        """Node 3: generate the final response (non-streaming graph path)."""
+        logger.info("Generating final response")
+        state.final_response = await self.response_generator.generate(
+            self._response_prompt_text(state), self.response_sampling
+        )
+        logger.info("Final response generated")
+        return state
+
+    def _should_retrieve(self, state: AgentState) -> str:
+        if state.tool_calls:
+            logger.info("Routing to retrieve_data")
+            return "retrieve"
+        logger.info("Routing to generate_response")
+        return "respond"
+
+    # --- public API ------------------------------------------------------
+    async def query(
+        self,
+        user_query: str,
+        user_id: str,
+        user_context: str = "",
+        chat_history: list[ChatMessage] | None = None,
+    ) -> dict[str, Any]:
+        """Batch path through the compiled graph (reference llm_agent.py:175)."""
+        logger.info("Processing query for user %s: %s", user_id, user_query)
+        state = AgentState(
+            user_query=user_query,
+            user_id=user_id,
+            user_context=user_context,
+            chat_history=list(chat_history or []),
+            tool_calls=deque(),
+        )
+        final_state = await self.graph.ainvoke(state)
+        return {
+            "response": final_state.final_response,
+            "retrieved_transactions_count": len(final_state.retrieved_transactions),
+            "state": final_state,
+        }
+
+    async def stream_with_status(
+        self,
+        user_query: str,
+        user_id: str,
+        user_context: str = "",
+        chat_history: list[ChatMessage] | None = None,
+    ) -> AsyncGenerator[dict[str, Any], None]:
+        """Streaming path with status events (reference llm_agent.py:202-252);
+        event shapes/messages kept verbatim."""
+        logger.info("Processing query with status streaming for user %s: %s", user_id, user_query)
+        yield {"type": "status", "message": "Starting query processing..."}
+
+        state = AgentState(
+            user_query=user_query,
+            user_id=user_id,
+            user_context=user_context,
+            chat_history=list(chat_history or []),
+            tool_calls=deque(),
+        )
+
+        yield {"type": "status", "message": "Analyzing query to determine if transaction data is needed..."}
+        state = await self._decide_retrieval_node(state)
+
+        if self._should_retrieve(state) == "retrieve":
+            yield {"type": "status", "message": "Retrieving relevant transaction data..."}
+            state = await self._retrieve_data_node(state)
+            yield {
+                "type": "retrieval_complete",
+                "count": len(state.retrieved_transactions),
+                "message": f"Retrieved {len(state.retrieved_transactions)} transactions",
+            }
+        else:
+            yield {"type": "status", "message": "No transaction data retrieval needed"}
+
+        yield {"type": "status", "message": "Generating response..."}
+
+        async for chunk in self.response_generator.stream(
+            self._response_prompt_text(state), self.response_sampling
+        ):
+            if chunk:
+                yield {"type": "response_chunk", "content": chunk}
+
+        yield {"type": "complete", "message": "Query processing completed"}
+        logger.info("Status streaming completed")

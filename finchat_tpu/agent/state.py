@@ -1,0 +1,31 @@
+"""Typed agent state.
+
+Native replacement for the reference's ``AgentState`` TypedDict + langchain
+ToolCall (``llm_agent.py:21-28``): same fields, same deque semantics for
+pending tool calls (only the first is honored per turn, llm_agent.py:100).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any
+
+from finchat_tpu.io.schemas import ChatMessage
+
+
+@dataclass
+class ToolCall:
+    name: str
+    args: dict[str, Any]
+
+
+@dataclass
+class AgentState:
+    user_query: str
+    user_id: str
+    user_context: str = ""
+    chat_history: list[ChatMessage] = field(default_factory=list)
+    tool_calls: deque[ToolCall] = field(default_factory=deque)
+    retrieved_transactions: list[str] = field(default_factory=list)
+    final_response: str | None = None

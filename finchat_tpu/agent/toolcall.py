@@ -1,0 +1,77 @@
+"""Tool-decision output parsing.
+
+The reference gets structured tool calls from Gemini's function-calling API
+(``llm_agent.py:98-101``). Here the decision LLM runs on-TPU and emits text,
+so the call format is parsed — strictly — from the model output, honoring
+the prompt contract (``tool_prompt.txt``):
+
+- the literal ``No tool call`` (tool_prompt.txt:12 parity) → no retrieval;
+- ``retrieve_transactions({...json...})`` → a validated ToolCall.
+
+Validation mirrors the reference's RetrievalIntent schema
+(``tools/qdrant_tool.py:39-68``): ``num_transactions`` bounded 1..10000,
+``time_period_days`` a positive int, ``search_query`` a string defaulting to
+"recent transactions". ``user_id`` is NEVER taken from the model — the
+executor overwrites it server-side (llm_agent.py:119-120 invariant).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+
+from finchat_tpu.agent.state import ToolCall
+from finchat_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+TOOL_NAME = "retrieve_transactions"
+NO_TOOL_LITERAL = "No tool call"
+
+_CALL_RE = re.compile(r"retrieve_transactions\s*\(\s*(\{.*?\})\s*\)", re.DOTALL)
+
+
+def _validate_args(raw: dict) -> dict:
+    args: dict = {}
+    sq = raw.get("search_query")
+    args["search_query"] = sq if isinstance(sq, str) and sq.strip() else "recent transactions"
+
+    n = raw.get("num_transactions")
+    if isinstance(n, bool):
+        n = None
+    if isinstance(n, (int, float)):
+        args["num_transactions"] = max(1, min(10_000, int(n)))
+
+    days = raw.get("time_period_days")
+    if isinstance(days, bool):
+        days = None
+    if isinstance(days, (int, float)) and int(days) > 0:
+        args["time_period_days"] = int(days)
+
+    # user_id from the model is dropped on the floor by construction
+    return args
+
+
+def parse_tool_decision(text: str) -> ToolCall | None:
+    """Parse the tool-decision model output into a ToolCall, or None."""
+    stripped = text.strip()
+    if not stripped or NO_TOOL_LITERAL.lower() in stripped.lower()[:80]:
+        return None
+
+    match = _CALL_RE.search(stripped)
+    if match is None:
+        if TOOL_NAME in stripped:
+            # named the tool but args are malformed → retrieve with defaults
+            logger.warning("tool call named without parsable args: %r", stripped[:120])
+            return ToolCall(name=TOOL_NAME, args=_validate_args({}))
+        return None
+
+    try:
+        raw = json.loads(match.group(1))
+    except json.JSONDecodeError:
+        logger.warning("unparsable tool-call JSON: %r", match.group(1)[:120])
+        return ToolCall(name=TOOL_NAME, args=_validate_args({}))
+
+    if not isinstance(raw, dict):
+        return ToolCall(name=TOOL_NAME, args=_validate_args({}))
+    return ToolCall(name=TOOL_NAME, args=_validate_args(raw))

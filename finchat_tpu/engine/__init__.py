@@ -1,0 +1,11 @@
+from finchat_tpu.engine.kv_cache import PageAllocator, PagedKVCache
+from finchat_tpu.engine.sampler import SamplingParams, sample
+from finchat_tpu.engine.engine import InferenceEngine
+
+__all__ = [
+    "PageAllocator",
+    "PagedKVCache",
+    "SamplingParams",
+    "sample",
+    "InferenceEngine",
+]

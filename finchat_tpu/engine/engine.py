@@ -1,0 +1,249 @@
+"""Inference engine: compiled prefill/decode step functions over the paged
+KV cache.
+
+TPU-first shape discipline (SURVEY §7.3 hard part #2): every jitted entry
+point has ONE static shape —
+
+- ``prefill_step``: batch 1 × ``prefill_chunk`` tokens. Arbitrary prompt
+  lengths become a loop of fixed-size chunks (chunked prefill, SURVEY §5.7a)
+  so there is no bucketing recompile storm.
+- ``decode_step``: the full ``max_seqs`` slot batch, every step. Inactive
+  slots ride along writing their KV to the trash page.
+
+State is donated on every call, so XLA aliases the cache buffers in place
+instead of copying the multi-GB pages each token.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from finchat_tpu.engine.kv_cache import (
+    PagedKVCache,
+    gather_kv,
+    scatter_kv_chunk,
+)
+from finchat_tpu.engine.sampler import sample
+from finchat_tpu.models.llama import LlamaConfig, forward
+from finchat_tpu.ops.refs import mha_reference
+from finchat_tpu.utils.config import EngineConfig
+from finchat_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class DecodeState:
+    """Device-resident engine state (a pytree; all leaves are arrays)."""
+
+    k_pages: Array  # [L, P, page_size, Hkv, hd]
+    v_pages: Array
+    page_table: Array  # [max_seqs, max_pages_per_seq] int32 (0 = trash)
+    context_lens: Array  # [max_seqs] int32 — tokens whose KV is cached
+    last_tokens: Array  # [max_seqs] int32 — next decode input per slot
+    rng: Array
+
+
+def create_state(
+    config: LlamaConfig, engine_cfg: EngineConfig, max_pages_per_seq: int
+) -> DecodeState:
+    cache = PagedKVCache.create(config, engine_cfg.num_pages, engine_cfg.page_size)
+    return DecodeState(
+        k_pages=cache.k_pages,
+        v_pages=cache.v_pages,
+        page_table=jnp.zeros((engine_cfg.max_seqs, max_pages_per_seq), jnp.int32),
+        context_lens=jnp.zeros((engine_cfg.max_seqs,), jnp.int32),
+        last_tokens=jnp.zeros((engine_cfg.max_seqs,), jnp.int32),
+        rng=jax.random.key(engine_cfg.max_seqs),
+    )
+
+
+def _paged_attention_fn(page_table: Array, start_pos: Array, n_valid: Array, page_size: int):
+    """Build the model's attention callback for paged prefill/decode.
+
+    ``page_table`` [B, max_pages], ``start_pos`` [B] (absolute position of
+    the first query token), ``n_valid`` [B] (real tokens in this chunk; 0
+    for inactive decode slots).
+    """
+
+    def attention(q: Array, k: Array, v: Array, layer_cache: Any, layer_idx: Array):
+        k_l, v_l = layer_cache
+        k_l, v_l = scatter_kv_chunk(k_l, v_l, k, v, page_table, start_pos, n_valid, page_size)
+        k_all, v_all = gather_kv(k_l, v_l, page_table, page_size)
+        out = mha_reference(
+            q, k_all, v_all,
+            causal=True,
+            q_offset=start_pos,
+            kv_len=start_pos + n_valid,
+        )
+        return out, (k_l, v_l)
+
+    return attention
+
+
+@partial(jax.jit, static_argnames=("config", "page_size"), donate_argnums=(1,))
+def prefill_step(
+    params: dict[str, Any],
+    state: DecodeState,
+    tokens: Array,  # [1, C] — one chunk of one sequence's prompt
+    slot: Array,  # scalar int32
+    start_pos: Array,  # scalar int32 — absolute position of tokens[0]
+    n_valid: Array,  # scalar int32 — real tokens in this chunk
+    *,
+    config: LlamaConfig,
+    page_size: int,
+) -> tuple[DecodeState, Array]:
+    """Run one prefill chunk; returns (state, last-valid-token logits [vocab])."""
+    C = tokens.shape[1]
+    positions = (start_pos + jnp.arange(C))[None, :]  # [1, C]
+    page_row = jax.lax.dynamic_slice_in_dim(state.page_table, slot, 1, axis=0)  # [1, max_pages]
+
+    attention = _paged_attention_fn(page_row, start_pos[None], n_valid[None], page_size)
+    logits, (k_pages, v_pages) = forward(
+        params, tokens, positions,
+        config=config, attention=attention,
+        cache=(state.k_pages, state.v_pages),
+    )
+    last_logits = jnp.take_along_axis(
+        logits[0], jnp.maximum(n_valid - 1, 0)[None, None], axis=0
+    )[0]  # [vocab]
+
+    new_state = dataclasses.replace(
+        state,
+        k_pages=k_pages,
+        v_pages=v_pages,
+        context_lens=state.context_lens.at[slot].add(n_valid),
+    )
+    return new_state, last_logits
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def commit_first_token(
+    state: DecodeState, slot: Array, logits: Array, temperature: Array, top_p: Array, top_k: Array
+) -> tuple[DecodeState, Array]:
+    """Sample the first generated token from prefill logits and arm the slot
+    for decode. (temperature/top_p/top_k are scalars for this one sequence.)"""
+    rng, sub = jax.random.split(state.rng)
+    token = sample(logits[None], sub, temperature[None], top_p[None], top_k[None])[0]
+    new_state = dataclasses.replace(
+        state,
+        last_tokens=state.last_tokens.at[slot].set(token),
+        rng=rng,
+    )
+    return new_state, token
+
+
+@partial(jax.jit, static_argnames=("config", "page_size"), donate_argnums=(1,))
+def decode_step(
+    params: dict[str, Any],
+    state: DecodeState,
+    active: Array,  # [max_seqs] bool
+    temperature: Array,  # [max_seqs]
+    top_p: Array,  # [max_seqs]
+    top_k: Array,  # [max_seqs] int32
+    *,
+    config: LlamaConfig,
+    page_size: int,
+) -> tuple[DecodeState, Array]:
+    """One decode step for ALL slots; returns (state, next_tokens [max_seqs]).
+
+    Each active slot's ``last_token`` KV is appended at ``context_lens`` and
+    the next token sampled from its logits. Inactive slots write to the
+    trash page and their sampled tokens are ignored by the host.
+    """
+    B = state.last_tokens.shape[0]
+    tokens = state.last_tokens[:, None]  # [B, 1]
+    positions = state.context_lens[:, None]  # [B, 1]
+    n_valid = active.astype(jnp.int32)  # [B]
+
+    attention = _paged_attention_fn(state.page_table, state.context_lens, n_valid, page_size)
+    logits, (k_pages, v_pages) = forward(
+        params, tokens, positions,
+        config=config, attention=attention,
+        cache=(state.k_pages, state.v_pages),
+    )
+    step_logits = logits[:, 0, :]  # [B, vocab]
+
+    rng, sub = jax.random.split(state.rng)
+    next_tokens = sample(step_logits, sub, temperature, top_p, top_k)
+
+    new_state = dataclasses.replace(
+        state,
+        k_pages=k_pages,
+        v_pages=v_pages,
+        context_lens=state.context_lens + n_valid,
+        last_tokens=jnp.where(active, next_tokens, state.last_tokens),
+        rng=rng,
+    )
+    return new_state, next_tokens
+
+
+class InferenceEngine:
+    """Host-side wrapper owning the device state and compiled steps.
+
+    Synchronous single-sequence generation lives here (the minimum
+    end-to-end slice, BASELINE config 1); the continuous-batching scheduler
+    (engine/scheduler.py) drives the same step functions for many sequences.
+    """
+
+    def __init__(self, config: LlamaConfig, params: dict[str, Any], engine_cfg: EngineConfig):
+        self.config = config
+        self.params = params
+        self.engine_cfg = engine_cfg
+        self.page_size = engine_cfg.page_size
+        self.max_pages_per_seq = min(
+            engine_cfg.num_pages - 1,
+            -(-engine_cfg.max_seq_len // engine_cfg.page_size),
+        )
+        self.state = create_state(config, engine_cfg, self.max_pages_per_seq)
+
+    # --- low-level ops used by the scheduler ----------------------------
+    def set_page_table_row(self, slot: int, pages: list[int]) -> None:
+        row = jnp.zeros((self.max_pages_per_seq,), jnp.int32)
+        row = row.at[: len(pages)].set(jnp.asarray(pages, jnp.int32))
+        self.state = dataclasses.replace(
+            self.state, page_table=self.state.page_table.at[slot].set(row)
+        )
+
+    def reset_slot(self, slot: int) -> None:
+        self.state = dataclasses.replace(
+            self.state,
+            page_table=self.state.page_table.at[slot].set(0),
+            context_lens=self.state.context_lens.at[slot].set(0),
+            last_tokens=self.state.last_tokens.at[slot].set(0),
+        )
+
+    def prefill(self, slot: int, prompt_ids: list[int]) -> Array:
+        """Chunked prefill of a whole prompt into a slot; returns the final
+        chunk's last-token logits."""
+        C = self.engine_cfg.prefill_chunk
+        start = 0
+        last_logits = None
+        while start < len(prompt_ids):
+            chunk = prompt_ids[start : start + C]
+            n_valid = len(chunk)
+            padded = chunk + [0] * (C - n_valid)
+            tokens = jnp.asarray(padded, jnp.int32)[None, :]
+            self.state, last_logits = prefill_step(
+                self.params, self.state, tokens,
+                jnp.int32(slot), jnp.int32(start), jnp.int32(n_valid),
+                config=self.config, page_size=self.page_size,
+            )
+            start += n_valid
+        assert last_logits is not None, "empty prompt"
+        return last_logits
+
+    def decode(self, active, temperature, top_p, top_k) -> Array:
+        self.state, next_tokens = decode_step(
+            self.params, self.state, active, temperature, top_p, top_k,
+            config=self.config, page_size=self.page_size,
+        )
+        return next_tokens

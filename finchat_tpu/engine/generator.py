@@ -1,0 +1,108 @@
+"""Text-generation abstraction consumed by the agent layer.
+
+``TextGenerator`` is the seam where the reference called the Gemini API
+(``llm_agent.py:88`` invoke, ``llm_agent.py:243`` astream): the agent only
+sees "prompt in → text chunks out". Implementations:
+
+- ``EngineGenerator`` — the TPU continuous-batching engine.
+- ``StubGenerator`` — canned responses for tests and the no-TPU dev loop
+  (plays the role of SURVEY §4.4's fake backend).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+from typing import AsyncIterator, Callable, Protocol
+
+from finchat_tpu.engine.sampler import SamplingParams
+from finchat_tpu.engine.scheduler import ContinuousBatchingScheduler
+from finchat_tpu.models.tokenizer import IncrementalDecoder, Tokenizer
+from finchat_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class GenerationError(RuntimeError):
+    pass
+
+
+class TextGenerator(Protocol):
+    async def stream(self, prompt: str, sampling: SamplingParams) -> AsyncIterator[str]: ...
+
+    async def generate(self, prompt: str, sampling: SamplingParams) -> str: ...
+
+
+class EngineGenerator:
+    def __init__(self, scheduler: ContinuousBatchingScheduler, tokenizer: Tokenizer):
+        self.scheduler = scheduler
+        self.tokenizer = tokenizer
+        self._ids = itertools.count()
+
+    async def stream(self, prompt: str, sampling: SamplingParams) -> AsyncIterator[str]:
+        prompt_ids = self.tokenizer.encode(prompt, add_bos=True)
+        seq_id = f"seq-{next(self._ids)}"
+        handle = await self.scheduler.submit(seq_id, prompt_ids, sampling)
+        decoder = IncrementalDecoder(self.tokenizer)
+        try:
+            while True:
+                event = await handle.events.get()
+                if event["type"] == "token":
+                    text = decoder.push(event["token_id"])
+                    if text:
+                        yield text
+                elif event["type"] == "done":
+                    tail = decoder.flush()
+                    if tail:
+                        yield tail
+                    return
+                else:  # error
+                    raise GenerationError(event["message"])
+        finally:
+            if not handle.finished:
+                self.scheduler.cancel(handle)
+
+    async def generate(self, prompt: str, sampling: SamplingParams) -> str:
+        return "".join([piece async for piece in self.stream(prompt, sampling)])
+
+
+class StubGenerator:
+    """Deterministic canned generator.
+
+    ``rules`` maps a predicate over the prompt to a response; first match
+    wins, else ``default``. Streams word-by-word with an optional delay to
+    exercise real async interleaving in tests.
+    """
+
+    def __init__(
+        self,
+        default: str = "This is a canned response.",
+        rules: list[tuple[Callable[[str], bool], str]] | None = None,
+        chunk_delay: float = 0.0,
+        fail_with: str | None = None,
+    ):
+        self.default = default
+        self.rules = rules or []
+        self.chunk_delay = chunk_delay
+        self.fail_with = fail_with
+        self.calls: list[str] = []  # prompts seen, for test assertions
+
+    def _respond(self, prompt: str) -> str:
+        for predicate, response in self.rules:
+            if predicate(prompt):
+                return response
+        return self.default
+
+    async def stream(self, prompt: str, sampling: SamplingParams) -> AsyncIterator[str]:
+        self.calls.append(prompt)
+        if self.fail_with is not None:
+            raise GenerationError(self.fail_with)
+        response = self._respond(prompt)
+        pieces = response.split(" ")
+        for i, piece in enumerate(pieces):
+            if self.chunk_delay:
+                await asyncio.sleep(self.chunk_delay)
+            yield piece + (" " if i < len(pieces) - 1 else "")
+
+    async def generate(self, prompt: str, sampling: SamplingParams) -> str:
+        return "".join([piece async for piece in self.stream(prompt, sampling)])

@@ -1,0 +1,181 @@
+"""Paged KV cache: device arrays + host-side page allocator.
+
+The reference has no KV cache (inference is a remote API call); this is the
+memory system that makes long RAG contexts (unbounded history + up to 10,000
+retrieved transactions, reference qdrant_tool.py:145 / llm_agent.py:234-236)
+servable on fixed TPU HBM:
+
+- Device side: ``k_pages``/``v_pages`` shaped ``[n_layers, num_pages,
+  page_size, n_kv_heads, head_dim]``. Physical page 0 is a TRASH page —
+  writes from padding lanes and inactive slots are redirected there, which
+  keeps every jitted step a fixed-shape scatter with no host branching.
+- Host side: ``PageAllocator`` — a free list with ownership tracking and the
+  scheduler invariants of SURVEY §5.2 enforced at every call: a page is
+  owned by at most one sequence; double-free and foreign-free raise.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from finchat_tpu.models.llama import LlamaConfig
+from finchat_tpu.utils.logging import get_logger
+from finchat_tpu.utils.metrics import METRICS
+
+logger = get_logger(__name__)
+
+TRASH_PAGE = 0
+
+
+@dataclass
+class PagedKVCache:
+    """Device-side paged cache tensors (a pytree; leaves have leading L axis
+    so the model's ``lax.scan`` over layers slices one layer's pages)."""
+
+    k_pages: Any  # [L, P, page_size, Hkv, head_dim]
+    v_pages: Any  # [L, P, page_size, Hkv, head_dim]
+    page_size: int
+    num_pages: int
+
+    @classmethod
+    def create(cls, config: LlamaConfig, num_pages: int, page_size: int) -> "PagedKVCache":
+        shape = (config.n_layers, num_pages, page_size, config.n_kv_heads, config.head_dim)
+        return cls(
+            k_pages=jnp.zeros(shape, config.dtype),
+            v_pages=jnp.zeros(shape, config.dtype),
+            page_size=page_size,
+            num_pages=num_pages,
+        )
+
+    def layers_pytree(self) -> tuple[Any, Any]:
+        """The (k, v) pair fed to the model forward as the scan-sliced cache."""
+        return (self.k_pages, self.v_pages)
+
+    def hbm_bytes(self) -> int:
+        return self.k_pages.nbytes + self.v_pages.nbytes
+
+
+class PageAllocationError(RuntimeError):
+    pass
+
+
+class PageAllocator:
+    """Host-side free-list allocator with ownership invariants.
+
+    Page 0 is reserved as the trash page and never handed out.
+    """
+
+    def __init__(self, num_pages: int):
+        if num_pages < 2:
+            raise ValueError("need at least 2 pages (one is the trash page)")
+        self.num_pages = num_pages
+        self._free: list[int] = list(range(num_pages - 1, 0, -1))  # pop() yields low ids first
+        self._owner: dict[int, str] = {}  # page id -> sequence id
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_count(self) -> int:
+        return len(self._owner)
+
+    def can_allocate(self, n: int) -> bool:
+        return len(self._free) >= n
+
+    def allocate(self, seq_id: str, n: int) -> list[int]:
+        if n > len(self._free):
+            raise PageAllocationError(
+                f"requested {n} pages for {seq_id}, only {len(self._free)} free"
+            )
+        pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            assert p not in self._owner, f"invariant violation: page {p} already owned"
+            self._owner[p] = seq_id
+        METRICS.set_gauge("finchat_kv_pages_used", self.used_count)
+        return pages
+
+    def free(self, seq_id: str, pages: list[int]) -> None:
+        for p in pages:
+            owner = self._owner.get(p)
+            if owner is None:
+                raise PageAllocationError(f"double free of page {p} by {seq_id}")
+            if owner != seq_id:
+                raise PageAllocationError(
+                    f"sequence {seq_id} freeing page {p} owned by {owner}"
+                )
+            del self._owner[p]
+            self._free.append(p)
+        METRICS.set_gauge("finchat_kv_pages_used", self.used_count)
+
+    def owned_by(self, seq_id: str) -> list[int]:
+        return [p for p, s in self._owner.items() if s == seq_id]
+
+    def check_invariants(self) -> None:
+        """Every page is exactly one of {trash, free, owned-once}."""
+        free_set = set(self._free)
+        owned_set = set(self._owner)
+        assert len(free_set) == len(self._free), "duplicate pages in free list"
+        assert not (free_set & owned_set), "page both free and owned"
+        assert TRASH_PAGE not in free_set and TRASH_PAGE not in owned_set
+        assert free_set | owned_set | {TRASH_PAGE} == set(range(self.num_pages))
+
+
+def pages_needed(n_tokens: int, page_size: int) -> int:
+    return max(1, -(-n_tokens // page_size))
+
+
+def scatter_kv_chunk(
+    k_pages_layer: Any,  # [P, page_size, Hkv, hd] one layer's pages
+    v_pages_layer: Any,
+    k_new: Any,  # [B, C, Hkv, hd]
+    v_new: Any,
+    page_table: Any,  # [B, max_pages] int32 physical page ids (0 = trash)
+    start_pos: Any,  # [B] int32 absolute position of chunk token 0
+    n_valid: Any,  # [B] int32 how many of the C tokens are real
+    page_size: int,
+) -> tuple[Any, Any]:
+    """Scatter a chunk of new K/V into the paged layout (fixed shapes).
+
+    Token (b, i) lands at absolute position ``start_pos[b] + i`` →
+    logical page ``pos // page_size``, offset ``pos % page_size``, physical
+    page ``page_table[b, logical]``. Padding lanes (i >= n_valid[b]) are
+    redirected to the trash page.
+    """
+    B, C = k_new.shape[:2]
+    i = jnp.arange(C)[None, :]  # [1, C]
+    pos = start_pos[:, None] + i  # [B, C]
+    logical = pos // page_size
+    offset = pos % page_size
+    phys = jnp.take_along_axis(page_table, logical, axis=1)  # [B, C]
+    valid = i < n_valid[:, None]
+    phys = jnp.where(valid, phys, TRASH_PAGE)
+
+    flat_phys = phys.reshape(-1)
+    flat_off = offset.reshape(-1)
+    k_flat = k_new.reshape(B * C, *k_new.shape[2:])
+    v_flat = v_new.reshape(B * C, *v_new.shape[2:])
+    k_pages_layer = k_pages_layer.at[flat_phys, flat_off].set(k_flat, mode="drop")
+    v_pages_layer = v_pages_layer.at[flat_phys, flat_off].set(v_flat, mode="drop")
+    return k_pages_layer, v_pages_layer
+
+
+def gather_kv(
+    k_pages_layer: Any,  # [P, page_size, Hkv, hd]
+    v_pages_layer: Any,
+    page_table: Any,  # [B, max_pages]
+    page_size: int,
+) -> tuple[Any, Any]:
+    """Gather each sequence's pages into a contiguous [B, max_len, Hkv, hd]
+    view (max_len = max_pages * page_size). Reference path; the Pallas paged
+    kernel reads pages in place instead."""
+    B, max_pages = page_table.shape
+    k = k_pages_layer[page_table]  # [B, max_pages, page_size, Hkv, hd]
+    v = v_pages_layer[page_table]
+    k = k.reshape(B, max_pages * page_size, *k.shape[3:])
+    v = v.reshape(B, max_pages * page_size, *v.shape[3:])
+    return k, v

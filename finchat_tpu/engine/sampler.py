@@ -1,0 +1,67 @@
+"""Token sampling, fully inside jit (no host round-trip per token).
+
+Per-sequence sampling params are device arrays so one decode step samples a
+heterogeneous batch (different temperatures/top-p per conversation). Greedy
+is temperature == 0. Default temperature 0.5 for parity with the reference's
+both LLM roles (llm_agent.py:37,44).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    temperature: float = 0.5
+    top_p: float = 1.0
+    top_k: int = 0  # 0 = disabled
+    max_new_tokens: int = 1024
+    seed: int = 0
+
+
+def sample(
+    logits: Array,  # [B, vocab] fp32
+    rng: Array,
+    temperature: Array,  # [B]
+    top_p: Array,  # [B]
+    top_k: Array,  # [B] int32, 0 = disabled
+) -> Array:
+    """Sample next token ids [B] with per-sequence temperature/top-p/top-k.
+
+    Implementation: sort once descending, build the combined top-k/top-p
+    keep-mask in sorted order, renormalize, sample via Gumbel trick, undo the
+    sort. Greedy (temperature <= 0) short-circuits through the same path.
+    """
+    B, V = logits.shape
+    greedy = temperature <= 0.0
+
+    safe_temp = jnp.where(greedy, 1.0, temperature)
+    scaled = logits / safe_temp[:, None]
+
+    sort_idx = jnp.argsort(-scaled, axis=-1)  # descending
+    sorted_logits = jnp.take_along_axis(scaled, sort_idx, axis=-1)
+
+    # top-k mask in sorted space
+    ranks = jnp.arange(V)[None, :]
+    k_eff = jnp.where(top_k > 0, top_k, V)[:, None]
+    keep = ranks < k_eff
+
+    # top-p (nucleus) mask in sorted space: keep the smallest prefix whose
+    # cumulative probability exceeds top_p (always keep rank 0)
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cumprobs = jnp.cumsum(probs, axis=-1)
+    keep = keep & ((cumprobs - probs) < top_p[:, None])
+    keep = keep | (ranks == 0)
+
+    masked = jnp.where(keep, sorted_logits, -jnp.inf)
+    gumbel = jax.random.gumbel(rng, masked.shape, masked.dtype)
+    choice_sorted = jnp.argmax(masked + gumbel, axis=-1)  # [B]
+    sampled = jnp.take_along_axis(sort_idx, choice_sorted[:, None], axis=-1)[:, 0]
+
+    argmax = jnp.argmax(logits, axis=-1)
+    return jnp.where(greedy, argmax, sampled).astype(jnp.int32)

@@ -1,0 +1,246 @@
+"""Continuous-batching scheduler.
+
+Replaces the reference's "one message at a time per worker" concurrency model
+(``main.py:131-159``, SURVEY §2.3) with many sequences multiplexed onto one
+model replica:
+
+- Admission: pending sequences are admitted when a slot AND enough KV pages
+  for prompt + max_new_tokens are available (no mid-flight OOM).
+- Chunked prefill interleaved with decode: each loop iteration runs at most
+  one prefill chunk, then one decode step for all active slots — long
+  prompts cannot starve in-flight decodes (SURVEY §7.3 hard part 3).
+- Per-sequence failure isolation (SURVEY §5.3): an errored sequence is
+  evicted, its pages freed, an error event emitted on its stream, and the
+  engine keeps serving the others. The process-level watchdog of the
+  reference becomes per-sequence.
+- Invariants (SURVEY §5.2): the page allocator's ownership checks run at
+  every free; slot bookkeeping is single-task (the step loop) by design.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+import numpy as np
+
+from finchat_tpu.engine.engine import InferenceEngine, commit_first_token, prefill_step
+from finchat_tpu.engine.kv_cache import PageAllocator, pages_needed
+from finchat_tpu.engine.sampler import SamplingParams
+from finchat_tpu.utils.logging import get_logger
+from finchat_tpu.utils.metrics import METRICS
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class SequenceHandle:
+    """Host-side record of one in-flight sequence; ``events`` receives
+    ``{"type": "token", "token_id": int}``, then one terminal
+    ``{"type": "done", "reason": ...}`` or ``{"type": "error", ...}``."""
+
+    seq_id: str
+    prompt_ids: list[int]
+    sampling: SamplingParams
+    events: asyncio.Queue = field(default_factory=asyncio.Queue)
+    slot: int = -1
+    prefill_pos: int = 0  # prompt tokens already prefilled
+    generated: int = 0
+    submitted_at: float = field(default_factory=time.perf_counter)
+    first_token_at: float | None = None
+    finished: bool = False
+
+    def _emit_first_token_metrics(self) -> None:
+        if self.first_token_at is None:
+            self.first_token_at = time.perf_counter()
+            METRICS.observe("finchat_ttft_seconds", self.first_token_at - self.submitted_at)
+
+
+class ContinuousBatchingScheduler:
+    def __init__(self, engine: InferenceEngine, eos_id: int):
+        self.engine = engine
+        self.eos_id = eos_id
+        cfg = engine.engine_cfg
+        self.allocator = PageAllocator(cfg.num_pages)
+        self.free_slots: list[int] = list(range(cfg.max_seqs))
+        self.pending: deque[SequenceHandle] = deque()
+        self.prefilling: deque[SequenceHandle] = deque()
+        self.decoding: dict[int, SequenceHandle] = {}  # slot -> handle
+        B = cfg.max_seqs
+        self._temperature = np.zeros((B,), np.float32)
+        self._top_p = np.ones((B,), np.float32)
+        self._top_k = np.zeros((B,), np.int32)
+        self._wakeup = asyncio.Event()
+        self._task: asyncio.Task | None = None
+        self._running = False
+
+    # --- public API -----------------------------------------------------
+    async def start(self) -> None:
+        self._running = True
+        self._task = asyncio.create_task(self._loop())
+
+    async def stop(self) -> None:
+        self._running = False
+        self._wakeup.set()
+        if self._task:
+            await self._task
+
+    async def submit(self, seq_id: str, prompt_ids: list[int], sampling: SamplingParams) -> SequenceHandle:
+        if not prompt_ids:
+            raise ValueError("empty prompt")
+        max_len = self.engine.max_pages_per_seq * self.engine.page_size
+        if len(prompt_ids) + sampling.max_new_tokens > max_len:
+            raise ValueError(
+                f"sequence {seq_id}: prompt {len(prompt_ids)} + max_new "
+                f"{sampling.max_new_tokens} exceeds max length {max_len}"
+            )
+        handle = SequenceHandle(seq_id=seq_id, prompt_ids=list(prompt_ids), sampling=sampling)
+        self.pending.append(handle)
+        METRICS.set_gauge("finchat_queue_depth", len(self.pending))
+        self._wakeup.set()
+        return handle
+
+    def cancel(self, handle: SequenceHandle) -> None:
+        """Client went away (e.g. watchdog timeout): evict and free."""
+        if handle.finished:
+            return
+        if handle in self.pending:
+            self.pending.remove(handle)
+            self._finish(handle, "cancelled")
+            return
+        self._evict(handle, "cancelled")
+
+    # --- internals ------------------------------------------------------
+    def _admit(self) -> None:
+        while self.pending and self.free_slots:
+            handle = self.pending[0]
+            need = pages_needed(
+                len(handle.prompt_ids) + handle.sampling.max_new_tokens, self.engine.page_size
+            )
+            if need > self.engine.max_pages_per_seq or not self.allocator.can_allocate(need):
+                break  # head-of-line waits for pages
+            self.pending.popleft()
+            slot = self.free_slots.pop()
+            pages = self.allocator.allocate(handle.seq_id, need)
+            self.engine.set_page_table_row(slot, pages)
+            handle.slot = slot
+            self._temperature[slot] = handle.sampling.temperature
+            self._top_p[slot] = handle.sampling.top_p
+            self._top_k[slot] = handle.sampling.top_k
+            self.prefilling.append(handle)
+            METRICS.set_gauge("finchat_queue_depth", len(self.pending))
+            logger.debug("admitted %s into slot %d (%d pages)", handle.seq_id, slot, need)
+
+    def _finish(self, handle: SequenceHandle, reason: str) -> None:
+        handle.finished = True
+        handle.events.put_nowait({"type": "done", "reason": reason})
+
+    def _release(self, handle: SequenceHandle) -> None:
+        if handle.slot >= 0:
+            pages = self.allocator.owned_by(handle.seq_id)
+            if pages:
+                self.allocator.free(handle.seq_id, pages)
+            self.engine.reset_slot(handle.slot)
+            self.decoding.pop(handle.slot, None)
+            if handle in self.prefilling:
+                self.prefilling.remove(handle)
+            self.free_slots.append(handle.slot)
+            handle.slot = -1
+
+    def _evict(self, handle: SequenceHandle, reason: str, error: str | None = None) -> None:
+        self._release(handle)
+        if error is not None:
+            handle.finished = True
+            handle.events.put_nowait({"type": "error", "message": error})
+        else:
+            self._finish(handle, reason)
+
+    def _prefill_one_chunk(self, handle: SequenceHandle) -> None:
+        eng = self.engine
+        C = eng.engine_cfg.prefill_chunk
+        chunk = handle.prompt_ids[handle.prefill_pos : handle.prefill_pos + C]
+        n_valid = len(chunk)
+        tokens = jnp.asarray(chunk + [0] * (C - n_valid), jnp.int32)[None, :]
+        eng.state, last_logits = prefill_step(
+            eng.params, eng.state, tokens,
+            jnp.int32(handle.slot), jnp.int32(handle.prefill_pos), jnp.int32(n_valid),
+            config=eng.config, page_size=eng.page_size,
+        )
+        handle.prefill_pos += n_valid
+        if handle.prefill_pos >= len(handle.prompt_ids):
+            s = handle.sampling
+            eng.state, token = commit_first_token(
+                eng.state, jnp.int32(handle.slot), last_logits,
+                jnp.float32(s.temperature), jnp.float32(s.top_p), jnp.int32(s.top_k),
+            )
+            self.prefilling.remove(handle)
+            self.decoding[handle.slot] = handle
+            self._deliver(handle, int(token))
+
+    def _deliver(self, handle: SequenceHandle, token_id: int) -> None:
+        handle._emit_first_token_metrics()
+        handle.generated += 1
+        METRICS.inc("finchat_tokens_generated_total")
+        if token_id == self.eos_id:
+            self._evict(handle, "eos")
+        elif handle.generated >= handle.sampling.max_new_tokens:
+            handle.events.put_nowait({"type": "token", "token_id": token_id})
+            self._evict(handle, "length")
+        else:
+            handle.events.put_nowait({"type": "token", "token_id": token_id})
+
+    def _decode_once(self) -> None:
+        eng = self.engine
+        B = eng.engine_cfg.max_seqs
+        active = np.zeros((B,), bool)
+        for slot in self.decoding:
+            active[slot] = True
+        next_tokens = eng.decode(
+            jnp.asarray(active),
+            jnp.asarray(self._temperature),
+            jnp.asarray(self._top_p),
+            jnp.asarray(self._top_k),
+        )
+        tokens_host = np.asarray(next_tokens)
+        for slot, handle in list(self.decoding.items()):
+            self._deliver(handle, int(tokens_host[slot]))
+        METRICS.set_gauge("finchat_batch_occupancy", len(self.decoding))
+
+    async def _loop(self) -> None:
+        logger.info("scheduler loop started (max_seqs=%d)", self.engine.engine_cfg.max_seqs)
+        while self._running:
+            if not (self.pending or self.prefilling or self.decoding):
+                self._wakeup.clear()
+                try:
+                    await asyncio.wait_for(self._wakeup.wait(), timeout=0.5)
+                except asyncio.TimeoutError:
+                    pass
+                continue
+
+            self._admit()
+
+            # one prefill chunk, interleaved with decode so TTFT work cannot
+            # starve in-flight streams
+            if self.prefilling:
+                handle = self.prefilling[0]
+                try:
+                    self._prefill_one_chunk(handle)
+                except Exception as e:  # per-sequence isolation
+                    logger.error("prefill error for %s: %s", handle.seq_id, e)
+                    self._evict(handle, "error", error=str(e))
+
+            if self.decoding:
+                try:
+                    self._decode_once()
+                except Exception as e:
+                    # a whole-batch failure is not attributable to one
+                    # sequence: fail all in-flight decodes, keep serving
+                    logger.error("decode step error: %s", e)
+                    for handle in list(self.decoding.values()):
+                        self._evict(handle, "error", error=str(e))
+
+            await asyncio.sleep(0)  # let producers/consumers run
+        logger.info("scheduler loop stopped")

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import json
 import threading
+import uuid
 import zlib
 from dataclasses import dataclass, field
 from typing import Any, Callable
@@ -89,6 +90,7 @@ class _PartitionLog:
 class _GroupState:
     def __init__(self) -> None:
         self.members: list[str] = []
+        self.subscriptions: dict[str, list[str]] = {}  # member -> topics
         self.offsets: dict[tuple[str, int], int] = {}  # (topic, partition) -> next offset
 
 
@@ -125,6 +127,7 @@ class InMemoryBroker:
             group = self._groups.setdefault(group_id, _GroupState())
             if member_id not in group.members:
                 group.members.append(member_id)
+            group.subscriptions[member_id] = list(topics)
             for topic in topics:
                 logs = self._ensure_topic(topic)
                 for part, log in enumerate(logs):
@@ -137,13 +140,19 @@ class InMemoryBroker:
             group = self._groups.get(group_id)
             if group and member_id in group.members:
                 group.members.remove(member_id)
+                group.subscriptions.pop(member_id, None)
 
     def _assignment(self, group: _GroupState, member_id: str, topics: list[str]) -> list[tuple[str, int]]:
-        """Round-robin partition assignment across live group members."""
-        idx = group.members.index(member_id)
-        n = len(group.members)
+        """Round-robin partition assignment, per topic, among the members
+        actually subscribed to that topic (so mixed-subscription groups
+        leave no partition orphaned)."""
         out = []
         for topic in topics:
+            subscribers = [m for m in group.members if topic in group.subscriptions.get(m, ())]
+            if member_id not in subscribers:
+                continue
+            idx = subscribers.index(member_id)
+            n = len(subscribers)
             for part in range(self.num_partitions):
                 if part % n == idx:
                     out.append((topic, part))
@@ -172,28 +181,27 @@ class InMemoryBroker:
 
 
 _PROCESS_BROKER: InMemoryBroker | None = None
+_PROCESS_BROKER_LOCK = threading.Lock()
 
 
 def default_broker() -> InMemoryBroker:
     """Process-wide shared broker for the memory backend, so independently
     constructed producers and consumers in one process see each other."""
     global _PROCESS_BROKER
-    if _PROCESS_BROKER is None:
-        _PROCESS_BROKER = InMemoryBroker()
-    return _PROCESS_BROKER
+    with _PROCESS_BROKER_LOCK:
+        if _PROCESS_BROKER is None:
+            _PROCESS_BROKER = InMemoryBroker()
+        return _PROCESS_BROKER
 
 
 class KafkaClient:
     """Reference-compatible client (kafka_client.py) over either backend."""
 
-    _member_counter = 0
-
     def __init__(self, config: KafkaConfig | None = None, broker: InMemoryBroker | None = None):
         self.config = config or KafkaConfig()
         self._consumer_ready = False
         self._topics: list[str] = []
-        KafkaClient._member_counter += 1
-        self._member_id = f"member-{KafkaClient._member_counter}"
+        self._member_id = f"member-{uuid.uuid4().hex[:12]}"
 
         if self.config.backend == "confluent":
             if not HAVE_CONFLUENT:

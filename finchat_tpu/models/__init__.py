@@ -1,0 +1,12 @@
+from finchat_tpu.models.llama import LlamaConfig, PRESETS, init_params, forward
+from finchat_tpu.models.tokenizer import ByteTokenizer, IncrementalDecoder, get_tokenizer
+
+__all__ = [
+    "LlamaConfig",
+    "PRESETS",
+    "init_params",
+    "forward",
+    "ByteTokenizer",
+    "IncrementalDecoder",
+    "get_tokenizer",
+]

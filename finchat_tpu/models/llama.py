@@ -1,0 +1,210 @@
+"""Llama-family decoder in pure JAX.
+
+Replaces the reference's external LLM calls (``llm_agent.py:34-45`` — two
+ChatGoogleGenerativeAI instances) with an in-tree model. Design is TPU-first:
+
+- Params are plain pytrees with all layers STACKED on a leading axis so the
+  forward pass is a single ``lax.scan`` over layers — one compiled layer body
+  instead of n_layers inlined copies (fast compiles, identical HLO per step).
+- bf16 weights/activations, fp32 softmax and RMSNorm accumulation (MXU-
+  friendly dtype policy).
+- The attention inner op is a pluggable callback so the same forward serves
+  training (full causal), chunked prefill, and paged decode, with either the
+  jnp reference or Pallas kernels underneath.
+- Static shapes everywhere; positions are explicit inputs (no data-dependent
+  Python control flow under jit).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import Array, lax
+
+# attention callback signature:
+#   fn(q[B,S,H,D], k[B,S,Hkv,D], v[B,S,Hkv,D], layer_cache, layer_idx) ->
+#   (out[B,S,H,D], new_layer_cache)
+AttentionFn = Callable[[Array, Array, Array, Any, Array], tuple[Array, Any]]
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 260
+    dim: int = 128
+    n_layers: int = 2
+    n_heads: int = 4
+    n_kv_heads: int = 2
+    hidden_dim: int = 256
+    rope_theta: float = 10_000.0
+    norm_eps: float = 1e-5
+    max_seq_len: int = 2048
+    dtype: Any = jnp.bfloat16
+    tie_embeddings: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+# Model shapes follow the public architecture cards; "tiny"/"mini" are
+# random-weight debug/bench configs.
+PRESETS: dict[str, LlamaConfig] = {
+    "tiny": LlamaConfig(),
+    "mini": LlamaConfig(vocab_size=260, dim=512, n_layers=8, n_heads=8, n_kv_heads=4, hidden_dim=1536, max_seq_len=4096),
+    "tinyllama-1.1b": LlamaConfig(
+        vocab_size=32_000, dim=2048, n_layers=22, n_heads=32, n_kv_heads=4,
+        hidden_dim=5632, rope_theta=10_000.0, max_seq_len=2048,
+    ),
+    "llama3-8b": LlamaConfig(
+        vocab_size=128_256, dim=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+        hidden_dim=14_336, rope_theta=500_000.0, max_seq_len=8192,
+    ),
+    "llama3-70b": LlamaConfig(
+        vocab_size=128_256, dim=8192, n_layers=80, n_heads=64, n_kv_heads=8,
+        hidden_dim=28_672, rope_theta=500_000.0, max_seq_len=8192,
+    ),
+}
+
+
+def init_params(config: LlamaConfig, key: Array) -> dict[str, Any]:
+    """Random-init params as a pytree with stacked layers.
+
+    Layout (L = n_layers, leading axis of every ``layers`` leaf):
+      embed[vocab, dim]
+      layers/attn_{q,k,v,o}[L, ...], layers/mlp_{gate,up,down}[L, ...],
+      layers/ln_attn[L, dim], layers/ln_mlp[L, dim]
+      norm[dim], lm_head[dim, vocab] (absent when tie_embeddings)
+    """
+    c = config
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+
+    def dense(k: Array, shape: tuple[int, ...], fan_in: int) -> Array:
+        return (jax.random.normal(k, shape, jnp.float32) * fan_in ** -0.5).astype(c.dtype)
+
+    keys = jax.random.split(k_layers, 7)
+    L, D, H, Hkv, hd, F = c.n_layers, c.dim, c.n_heads, c.n_kv_heads, c.head_dim, c.hidden_dim
+    params: dict[str, Any] = {
+        "embed": dense(k_embed, (c.vocab_size, D), D),
+        "layers": {
+            "attn_q": dense(keys[0], (L, D, H * hd), D),
+            "attn_k": dense(keys[1], (L, D, Hkv * hd), D),
+            "attn_v": dense(keys[2], (L, D, Hkv * hd), D),
+            "attn_o": dense(keys[3], (L, H * hd, D), H * hd),
+            "mlp_gate": dense(keys[4], (L, D, F), D),
+            "mlp_up": dense(keys[5], (L, D, F), D),
+            "mlp_down": dense(keys[6], (L, F, D), F),
+            "ln_attn": jnp.ones((L, D), c.dtype),
+            "ln_mlp": jnp.ones((L, D), c.dtype),
+        },
+        "norm": jnp.ones((D,), c.dtype),
+    }
+    if not c.tie_embeddings:
+        params["lm_head"] = dense(k_head, (D, c.vocab_size), D)
+    return params
+
+
+def rms_norm(x: Array, weight: Array, eps: float) -> Array:
+    x32 = x.astype(jnp.float32)
+    rms = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * rms).astype(x.dtype) * weight
+
+
+def rope(x: Array, positions: Array, theta: float) -> Array:
+    """Rotary position embedding, fp32 math. x: [B,S,H,D], positions: [B,S]."""
+    B, S, H, D = x.shape
+    half = D // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)  # [half]
+    angles = positions[:, :, None].astype(jnp.float32) * freqs[None, None, :]  # [B,S,half]
+    cos = jnp.cos(angles)[:, :, None, :]  # [B,S,1,half]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x32 = x.astype(jnp.float32)
+    x1, x2 = x32[..., :half], x32[..., half:]
+    rotated = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return rotated.astype(x.dtype)
+
+
+def _layer(
+    x: Array,
+    layer_params: dict[str, Array],
+    layer_cache: Any,
+    layer_idx: Array,
+    *,
+    positions: Array,
+    config: LlamaConfig,
+    attention: AttentionFn,
+) -> tuple[Array, Any]:
+    c = config
+    B, S, D = x.shape
+
+    h = rms_norm(x, layer_params["ln_attn"], c.norm_eps)
+    q = (h @ layer_params["attn_q"]).reshape(B, S, c.n_heads, c.head_dim)
+    k = (h @ layer_params["attn_k"]).reshape(B, S, c.n_kv_heads, c.head_dim)
+    v = (h @ layer_params["attn_v"]).reshape(B, S, c.n_kv_heads, c.head_dim)
+    q = rope(q, positions, c.rope_theta)
+    k = rope(k, positions, c.rope_theta)
+
+    attn_out, new_layer_cache = attention(q, k, v, layer_cache, layer_idx)
+    x = x + (attn_out.reshape(B, S, -1) @ layer_params["attn_o"])
+
+    h = rms_norm(x, layer_params["ln_mlp"], c.norm_eps)
+    gate = h @ layer_params["mlp_gate"]
+    up = h @ layer_params["mlp_up"]
+    x = x + ((jax.nn.silu(gate.astype(jnp.float32)).astype(up.dtype) * up) @ layer_params["mlp_down"])
+    return x, new_layer_cache
+
+
+def forward(
+    params: dict[str, Any],
+    tokens: Array,  # [B, S] int32
+    positions: Array,  # [B, S] int32 absolute positions
+    *,
+    config: LlamaConfig,
+    attention: AttentionFn,
+    cache: Any = None,  # pytree whose leaves have leading axis n_layers, or None
+) -> tuple[Array, Any]:
+    """Run the decoder; returns (logits[B,S,vocab] fp32, new_cache)."""
+    c = config
+    x = params["embed"][tokens]  # [B,S,D]
+
+    def scan_body(carry, scanned):
+        x = carry
+        layer_params, layer_cache, layer_idx = scanned
+        x, new_layer_cache = _layer(
+            x, layer_params, layer_cache, layer_idx,
+            positions=positions, config=c, attention=attention,
+        )
+        return x, new_layer_cache
+
+    layer_ids = jnp.arange(c.n_layers)
+    cacheless = cache is None
+    cache_xs = jnp.zeros((c.n_layers,), jnp.int32) if cacheless else cache
+    x, new_cache = lax.scan(scan_body, x, (params["layers"], cache_xs, layer_ids))
+    if cacheless:
+        new_cache = None
+
+    x = rms_norm(x, params["norm"], c.norm_eps)
+    head = params["embed"].T if c.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("bsd,dv->bsv", x, head, preferred_element_type=jnp.float32)
+    return logits, new_cache
+
+
+def full_causal_attention(q: Array, k: Array, v: Array, layer_cache: Any, layer_idx: Array) -> tuple[Array, Any]:
+    """Cache-less causal attention over the whole sequence (training, tests,
+    one-shot prefill). Uses the jnp reference; the Pallas flash kernel slots
+    in via ops.flash_attention."""
+    from finchat_tpu.ops.refs import mha_reference
+
+    return mha_reference(q, k, v, causal=True), layer_cache
+
+
+@partial(jax.jit, static_argnames=("config",))
+def forward_full(params: dict[str, Any], tokens: Array, positions: Array, *, config: LlamaConfig) -> Array:
+    """Convenience jitted forward with full causal attention, no cache."""
+    logits, _ = forward(
+        params, tokens, positions, config=config, attention=full_causal_attention, cache=None
+    )
+    return logits

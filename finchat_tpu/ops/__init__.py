@@ -1,0 +1,3 @@
+from finchat_tpu.ops.refs import mha_reference, gqa_repeat
+
+__all__ = ["mha_reference", "gqa_repeat"]

@@ -1,0 +1,4 @@
+from finchat_tpu.serve.http import HTTPServer, Request, Response, StreamingResponse
+from finchat_tpu.serve.app import App, build_app
+
+__all__ = ["HTTPServer", "Request", "Response", "StreamingResponse", "App", "build_app"]

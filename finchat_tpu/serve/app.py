@@ -1,0 +1,273 @@
+"""Application wiring: the Kafka worker loop + HTTP surface.
+
+Behavior parity with the reference ``main.py``:
+
+- lifespan: store connection check → consumer setup → consume task
+  (main.py:24-30), plus scheduler startup (new).
+- ``GET /health`` → ``{"status": "healthy"}`` (main.py:51-53).
+- ``process_message``: context+history fetch (errors drop the message,
+  main.py:64-70), stream_with_status fan-out where ONLY ``response_chunk``
+  and ``complete`` events reach Kafka (main.py:81-110), flushed error chunk
+  on failure (main.py:112-122), post-hoc persistence (main.py:125-129).
+- consume loop: per-message watchdog (100 s default — main.py:138) emitting
+  the timeout chunk, 10 ms idle sleep, 1 s error backoff (main.py:131-159).
+- ``POST /chat`` — the reference's commented-out REST path (main.py:44-49),
+  implemented: batch ``llm_agent.query``.
+- ``POST /chat/stream`` — SSE stream of the FULL internal event protocol
+  (status/retrieval_complete/response_chunk/complete), the "richer consumer"
+  SURVEY §2.4 calls for.
+- ``GET /metrics`` — Prometheus text (new; SURVEY §5.5).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from pathlib import Path
+
+import jax
+
+from finchat_tpu.agent.graph import LLMAgent
+from finchat_tpu.engine.generator import EngineGenerator, StubGenerator, TextGenerator
+from finchat_tpu.engine.engine import InferenceEngine
+from finchat_tpu.engine.sampler import SamplingParams
+from finchat_tpu.engine.scheduler import ContinuousBatchingScheduler
+from finchat_tpu.io.kafka import KafkaClient
+from finchat_tpu.io.schemas import complete_chunk, error_chunk, response_chunk, timeout_chunk
+from finchat_tpu.io.store import ConversationStore, make_store
+from finchat_tpu.models.llama import PRESETS, init_params
+from finchat_tpu.models.tokenizer import get_tokenizer
+from finchat_tpu.serve.http import HTTPServer, Request, Response, StreamingResponse, sse_event
+from finchat_tpu.tools.retrieval import TransactionRetriever
+from finchat_tpu.utils.config import AI_RESPONSE_TOPIC, AppConfig
+from finchat_tpu.utils.logging import get_logger
+from finchat_tpu.utils.metrics import METRICS
+
+logger = get_logger(__name__)
+
+_PROMPTS_DIR = Path(__file__).resolve().parent.parent.parent / "prompts"
+
+
+def load_prompts() -> tuple[str, str]:
+    system_prompt = (_PROMPTS_DIR / "system_prompt.txt").read_text()
+    tool_prompt = (_PROMPTS_DIR / "tool_prompt.txt").read_text()
+    return system_prompt, tool_prompt
+
+
+def build_generators(cfg: AppConfig) -> tuple[TextGenerator, TextGenerator, ContinuousBatchingScheduler | None, object]:
+    """Construct (tool_generator, response_generator, scheduler, tokenizer).
+
+    ``model.preset == "stub"`` wires canned generators (dev/no-TPU); anything
+    else builds the TPU engine with one shared continuous-batching scheduler
+    serving both agent roles.
+    """
+    if cfg.model.preset == "stub":
+        stub = StubGenerator(default="I'm Penny, here to help with your finances.")
+        return stub, stub, None, get_tokenizer()
+
+    config = PRESETS[cfg.model.preset]
+    tokenizer = get_tokenizer(cfg.model.tokenizer_path)
+    if cfg.model.checkpoint_path:
+        from finchat_tpu.checkpoints.hf_loader import load_llama_params
+
+        params = load_llama_params(cfg.model.checkpoint_path, config)
+    else:
+        logger.warning("no checkpoint configured; using RANDOM weights (preset=%s)", cfg.model.preset)
+        params = init_params(config, jax.random.key(cfg.model.seed))
+    engine = InferenceEngine(config, params, cfg.engine)
+    scheduler = ContinuousBatchingScheduler(engine, eos_id=tokenizer.eos_id)
+    generator = EngineGenerator(scheduler, tokenizer)
+    return generator, generator, scheduler, tokenizer
+
+
+class App:
+    """One worker process: HTTP surface + Kafka consume loop + engine."""
+
+    def __init__(self, cfg: AppConfig, *, agent: LLMAgent, store: ConversationStore,
+                 kafka: KafkaClient, scheduler: ContinuousBatchingScheduler | None = None):
+        self.cfg = cfg
+        self.agent = agent
+        self.store = store
+        self.kafka = kafka
+        self.scheduler = scheduler
+        self.server = HTTPServer(cfg.serve.host, cfg.serve.port)
+        self.server.route("GET", "/health", self.health)
+        self.server.route("GET", "/metrics", self.metrics)
+        self.server.route("POST", "/chat", self.chat)
+        self.server.route("POST", "/chat/stream", self.chat_stream)
+        self._consume_task: asyncio.Task | None = None
+        self._running = False
+
+    # --- lifespan -------------------------------------------------------
+    async def start(self, serve_http: bool = True) -> None:
+        await self.store.check_connection()
+        self.kafka.setup_consumer()
+        if self.scheduler is not None:
+            await self.scheduler.start()
+        self._running = True
+        self._consume_task = asyncio.create_task(self.consume_messages())
+        if serve_http:
+            await self.server.start()
+
+    async def stop(self) -> None:
+        self._running = False
+        if self._consume_task:
+            self._consume_task.cancel()
+            try:
+                await self._consume_task
+            except asyncio.CancelledError:
+                pass
+        if self.scheduler is not None:
+            await self.scheduler.stop()
+        await self.server.stop()
+        self.kafka.close()
+
+    # --- HTTP handlers --------------------------------------------------
+    async def health(self, request: Request) -> Response:
+        return Response.json({"status": "healthy"})
+
+    async def metrics(self, request: Request) -> Response:
+        return Response.text(METRICS.render_prometheus(), content_type="text/plain; version=0.0.4")
+
+    async def chat(self, request: Request) -> Response:
+        """Batch REST path (the reference's commented POST /process_message,
+        main.py:44-49): runs the compiled agent graph."""
+        payload = request.json()
+        missing = [k for k in ("conversation_id", "message", "user_id") if k not in payload]
+        if missing:
+            return Response.json({"detail": f"missing fields: {missing}"}, status=400)
+        user_context, _ = await self.store.get_context(payload["conversation_id"])
+        chat_history = await self.store.get_history(payload["conversation_id"])
+        result = await self.agent.query(payload["message"], payload["user_id"], user_context, chat_history)
+        return Response.json({
+            "response": result["response"],
+            "retrieved_transactions_count": result["retrieved_transactions_count"],
+        })
+
+    async def chat_stream(self, request: Request) -> Response | StreamingResponse:
+        """SSE stream of the full internal event protocol."""
+        payload = request.json()
+        missing = [k for k in ("conversation_id", "message", "user_id") if k not in payload]
+        if missing:
+            return Response.json({"detail": f"missing fields: {missing}"}, status=400)
+        user_context, _ = await self.store.get_context(payload["conversation_id"])
+        chat_history = await self.store.get_history(payload["conversation_id"])
+
+        async def events():
+            async for update in self.agent.stream_with_status(
+                payload["message"], payload["user_id"], user_context, chat_history
+            ):
+                yield sse_event(update)
+
+        return StreamingResponse(chunks=events())
+
+    # --- Kafka worker loop ----------------------------------------------
+    async def process_message(self, message) -> None:
+        message_value = json.loads(message.value().decode("utf-8"))
+        msg = message_value["message"]
+        conversation_id = message_value["conversation_id"]
+        full_message = ""
+        logger.info("Received message from Kafka: |%s| %s", conversation_id, msg)
+
+        try:
+            context, user_id = await self.store.get_context(conversation_id)
+            chat_history = await self.store.get_history(conversation_id)
+        except Exception as e:
+            logger.error("Error retrieving context or history for conversation %s: %s", conversation_id, e)
+            return
+
+        try:
+            async for update in self.agent.stream_with_status(msg, user_id, context, chat_history):
+                if update["type"] == "response_chunk":
+                    chunk_text = update["content"]
+                    full_message += chunk_text
+                    self.kafka.produce_message(
+                        AI_RESPONSE_TOPIC, conversation_id, response_chunk(message_value, chunk_text)
+                    )
+                    logger.debug("Processed chunk: %s", chunk_text)
+                elif update["type"] == "complete":
+                    self.kafka.produce_message(
+                        AI_RESPONSE_TOPIC, conversation_id, complete_chunk(message_value)
+                    )
+                    logger.info("Complete message sent to Kafka for conversation %s", conversation_id)
+                # status / retrieval_complete events are intentionally NOT
+                # forwarded (main.py:81-110 forwards only these two types)
+        except Exception as e:
+            logger.error("Error streaming LLM response: %s", e)
+            self.kafka.produce_error_message(
+                AI_RESPONSE_TOPIC, conversation_id, error_chunk(message_value)
+            )
+            return
+
+        try:
+            await self.store.save_ai_message(conversation_id=conversation_id, message=full_message, user_id=user_id)
+            logger.info("Message saved to DB for conversation %s", conversation_id)
+        except Exception as e:
+            logger.error("Error saving AI message to DB: %s", e)
+
+    async def consume_messages(self) -> None:
+        watchdog = self.cfg.engine.watchdog_seconds
+        while self._running:
+            try:
+                msg = self.kafka.poll_message()
+                if msg is not None:
+                    try:
+                        await asyncio.wait_for(self.process_message(msg), timeout=watchdog)
+                    except asyncio.TimeoutError:
+                        logger.error("Message processing timed out after %s seconds", watchdog)
+                        try:
+                            message_value = json.loads(msg.value().decode("utf-8"))
+                            self.kafka.produce_error_message(
+                                AI_RESPONSE_TOPIC,
+                                message_value["conversation_id"],
+                                timeout_chunk(message_value),
+                            )
+                        except Exception as e:
+                            logger.error("Failed to send timeout error message: %s", e)
+                else:
+                    await asyncio.sleep(0.01)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                logger.error("Error in message consumption: %s", e)
+                await asyncio.sleep(1)
+
+
+def build_app(cfg: AppConfig | None = None, *, store: ConversationStore | None = None,
+              kafka: KafkaClient | None = None,
+              tool_generator: TextGenerator | None = None,
+              response_generator: TextGenerator | None = None,
+              retriever=None) -> App:
+    """Assemble a worker from config, with injection points for tests/dev."""
+    from finchat_tpu.utils.config import load_config
+
+    cfg = cfg or load_config()
+    store = store or make_store(cfg.store)
+    kafka = kafka or KafkaClient(cfg.kafka)
+
+    scheduler = None
+    tokenizer = None
+    if tool_generator is None or response_generator is None:
+        tool_gen, resp_gen, scheduler, tokenizer = build_generators(cfg)
+        tool_generator = tool_generator or tool_gen
+        response_generator = response_generator or resp_gen
+
+    if retriever is None:
+        from finchat_tpu.embed.encoder import EMBED_PRESETS, EmbeddingEncoder, init_bert_params
+        from finchat_tpu.embed.index import DeviceVectorIndex
+
+        embed_cfg = EMBED_PRESETS[cfg.embed.preset]
+        embed_params = init_bert_params(embed_cfg, jax.random.key(1))
+        encoder = EmbeddingEncoder(embed_cfg, embed_params, tokenizer or get_tokenizer())
+        index = DeviceVectorIndex(dim=embed_cfg.dim)
+        retriever = TransactionRetriever(encoder, index)
+
+    system_prompt, tool_prompt = load_prompts()
+    agent = LLMAgent(
+        tool_generator, response_generator, retriever, system_prompt, tool_prompt,
+        response_sampling=SamplingParams(
+            temperature=cfg.engine.temperature, top_p=cfg.engine.top_p,
+            top_k=cfg.engine.top_k, max_new_tokens=cfg.engine.max_new_tokens,
+        ),
+    )
+    return App(cfg, agent=agent, store=store, kafka=kafka, scheduler=scheduler)

@@ -1,0 +1,4 @@
+from finchat_tpu.tools.retrieval import TransactionRetriever
+from finchat_tpu.tools.plot import create_financial_plot
+
+__all__ = ["TransactionRetriever", "create_financial_plot"]

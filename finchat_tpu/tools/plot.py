@@ -1,0 +1,83 @@
+"""create_financial_plot — chart generation over transaction data.
+
+The reference ships this tool as dead code (``tools/plot_tool.py``, never
+imported — SURVEY §2.1); here it is implemented and importable. Renders
+line/bar/pie/scatter/histogram charts from a JSON list of transactions and
+returns a base64 PNG data-URI, matching the reference tool's contract.
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import json
+from dataclasses import dataclass
+from typing import Any
+
+from finchat_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_CHART_TYPES = ("line", "bar", "pie", "scatter", "histogram")
+
+
+@dataclass
+class PlotConfig:
+    """Parity with the reference's PlotConfig schema (plot_tool.py:9-14)."""
+
+    chart_type: str = "bar"
+    x_field: str = "date"
+    y_field: str = "amount"
+    title: str = "Financial Plot"
+
+
+def create_financial_plot(transactions_json: str, config: PlotConfig | None = None) -> str:
+    """Render a chart from transaction JSON → ``data:image/png;base64,...``.
+
+    ``transactions_json``: JSON list of objects with at least the configured
+    x/y fields. Raises ValueError on malformed input or unknown chart type.
+    """
+    import matplotlib
+
+    matplotlib.use("Agg")  # headless
+    import matplotlib.pyplot as plt
+    import pandas as pd
+
+    cfg = config or PlotConfig()
+    if cfg.chart_type not in _CHART_TYPES:
+        raise ValueError(f"unknown chart_type {cfg.chart_type!r}; expected one of {_CHART_TYPES}")
+
+    rows: Any = json.loads(transactions_json)
+    if not isinstance(rows, list) or not rows:
+        raise ValueError("transactions_json must be a non-empty JSON list")
+    frame = pd.DataFrame(rows)
+    for column in (cfg.x_field, cfg.y_field) if cfg.chart_type != "histogram" else (cfg.y_field,):
+        if column not in frame.columns:
+            raise ValueError(f"field {column!r} missing from transactions")
+
+    fig, ax = plt.subplots(figsize=(8, 5))
+    try:
+        if cfg.chart_type == "line":
+            ax.plot(frame[cfg.x_field], frame[cfg.y_field])
+        elif cfg.chart_type == "bar":
+            ax.bar(frame[cfg.x_field].astype(str), frame[cfg.y_field])
+        elif cfg.chart_type == "scatter":
+            ax.scatter(frame[cfg.x_field], frame[cfg.y_field])
+        elif cfg.chart_type == "histogram":
+            ax.hist(frame[cfg.y_field], bins=min(20, max(5, len(frame) // 2)))
+        elif cfg.chart_type == "pie":
+            grouped = frame.groupby(cfg.x_field)[cfg.y_field].sum().abs()
+            ax.pie(grouped.values, labels=[str(l) for l in grouped.index], autopct="%1.1f%%")
+        if cfg.chart_type != "pie":
+            ax.set_xlabel(cfg.x_field)
+            ax.set_ylabel(cfg.y_field)
+            fig.autofmt_xdate(rotation=30)
+        ax.set_title(cfg.title)
+        buf = io.BytesIO()
+        fig.savefig(buf, format="png", dpi=100, bbox_inches="tight")
+    finally:
+        plt.close(fig)
+
+    encoded = base64.b64encode(buf.getvalue()).decode("ascii")
+    logger.info("rendered %s chart (%d rows, %d png bytes)", cfg.chart_type, len(frame), len(buf.getvalue()))
+    return f"data:image/png;base64,{encoded}"

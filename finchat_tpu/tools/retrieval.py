@@ -1,0 +1,107 @@
+"""retrieve_transactions — the RAG tool.
+
+Behavior parity with the reference tool (``tools/qdrant_tool.py:75-177``),
+with the embedding + search moved on-device:
+
+- SECURITY: empty ``user_id`` → immediate ``[]`` (qdrant_tool.py:89-91);
+  the index query carries a must-filter on ``metadata.user_id``
+  (:105-112) AND every hit is re-checked post-hoc, skipped hits counted
+  and logged (:159-170).
+- ``num_transactions`` defaults to 10,000 when unset (:145);
+  ``time_period_days`` becomes ``metadata.date >= now - N days`` (:116-126).
+- Returns ``page_content`` strings only (:164); any exception → ``[]``
+  with an error log (:175-177).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable
+
+from finchat_tpu.embed.encoder import EmbeddingEncoder
+from finchat_tpu.embed.index import DeviceVectorIndex
+from finchat_tpu.utils.logging import get_logger
+from finchat_tpu.utils.metrics import METRICS
+
+logger = get_logger(__name__)
+
+DEFAULT_LIMIT = 10_000
+DEFAULT_QUERY = "recent transactions"
+
+
+class TransactionRetriever:
+    """Callable tool: validated args dict (``user_id`` already injected
+    server-side by the agent) → list of transaction texts."""
+
+    def __init__(
+        self,
+        encoder: EmbeddingEncoder,
+        index: DeviceVectorIndex,
+        *,
+        now: Callable[[], float] = time.time,
+    ):
+        self.encoder = encoder
+        self.index = index
+        self.now = now
+
+    async def __call__(self, args: dict[str, Any]) -> list[str]:
+        try:
+            user_id = args.get("user_id", "")
+            logger.info("Starting transaction retrieval for user_id: %s", user_id)
+            if not user_id:
+                logger.error("Security violation: user_id not provided")
+                return []
+
+            search_query = args.get("search_query") or DEFAULT_QUERY
+            limit = args.get("num_transactions") or DEFAULT_LIMIT
+            date_gte = None
+            days = args.get("time_period_days")
+            if days:
+                date_gte = self.now() - days * 86_400.0
+
+            query_vector = self.encoder.embed_query(search_query)
+            hits = self.index.query_points(
+                query_vector, limit=int(limit), user_id=user_id, date_gte=date_gte
+            )
+
+            transactions: list[str] = []
+            skipped = 0
+            for hit in hits:
+                payload = hit.payload
+                metadata = hit.metadata
+                # post-hoc security re-check, parity with qdrant_tool.py:159-170
+                if payload and metadata.get("user_id") == user_id:
+                    transactions.append(payload["page_content"])
+                else:
+                    skipped += 1
+                    logger.warning(
+                        "Security check: Skipping transaction with mismatched user_id. "
+                        "Expected: %s, Got: %s", user_id, metadata.get("user_id"),
+                    )
+            if skipped:
+                logger.warning("Skipped %d transactions due to user_id mismatch", skipped)
+                METRICS.inc("finchat_retrieval_security_skips_total", skipped)
+
+            METRICS.inc("finchat_retrievals_total")
+            logger.info("Successfully processed %d transactions", len(transactions))
+            return transactions
+        except Exception as e:
+            logger.error("Error retrieving transactions: %s", e, exc_info=True)
+            return []
+
+    # --- ingestion side (the reference's upsert path lives out-of-repo;
+    # here it is first-class so the product is self-contained) ------------
+    def upsert_transactions(self, user_id: str, texts: list[str], dates: list[float] | None = None) -> None:
+        from finchat_tpu.embed.index import VectorPoint
+
+        vectors = self.encoder.embed_batch(texts)
+        dates = dates or [self.now()] * len(texts)
+        points = [
+            VectorPoint(
+                id=f"{user_id}-{i}-{int(dates[i])}",
+                vector=vectors[i],
+                payload={"page_content": texts[i], "metadata": {"user_id": user_id, "date": dates[i]}},
+            )
+            for i in range(len(texts))
+        ]
+        self.index.upsert(points)

@@ -182,6 +182,8 @@ def _apply_overrides(cfg: Any, overrides: dict[str, Any]) -> None:
         node = cfg
         parts = key.split(".")
         for part in parts[:-1]:
+            if not hasattr(node, part):
+                raise KeyError(f"unknown config key: {key!r}")
             node = getattr(node, part)
         leaf = parts[-1]
         if not hasattr(node, leaf):

@@ -7,10 +7,17 @@ TPU. Must happen before any ``import jax`` in the test process.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The image's sitecustomize imports jax at interpreter boot and pins the
+# axon (TPU-tunnel) platform, so env vars set here are too late; the config
+# update below still works because no backend is initialized yet.
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.device_count() == 8, "tests require the virtual 8-device CPU mesh"
 
 import asyncio  # noqa: E402
 import inspect  # noqa: E402

@@ -1,0 +1,115 @@
+"""Agent graph semantics + the internal streaming event protocol
+(reference llm_agent.py:57-79, :202-252)."""
+
+from finchat_tpu.agent.graph import LLMAgent
+from finchat_tpu.engine.generator import StubGenerator
+from finchat_tpu.io.schemas import ChatMessage
+
+SYSTEM = "You are Penny."
+TOOL = "Decide retrieval."
+
+
+def make_agent(tool_response="No tool call", response_text="Here is my advice.",
+               retriever=None, **kwargs):
+    async def default_retriever(args):
+        return [f"txn for {args['user_id']}"]
+
+    return LLMAgent(
+        StubGenerator(default=tool_response),
+        StubGenerator(default=response_text),
+        retriever or default_retriever,
+        SYSTEM, TOOL,
+        today=lambda: "2026-07-29",
+        **kwargs,
+    )
+
+
+async def test_no_retrieval_path():
+    agent = make_agent(tool_response="No tool call")
+    result = await agent.query("How should I invest?", "u1", "CTX", [])
+    assert result["response"] == "Here is my advice."
+    assert result["retrieved_transactions_count"] == 0
+
+
+async def test_retrieval_path_injects_user_id():
+    seen = {}
+
+    async def retriever(args):
+        seen.update(args)
+        return ["t1", "t2"]
+
+    agent = make_agent(
+        tool_response='retrieve_transactions({"search_query": "groceries", "user_id": "attacker"})',
+        retriever=retriever,
+    )
+    result = await agent.query("What did I spend?", "real-user", "CTX", [])
+    assert seen["user_id"] == "real-user"  # server-side injection wins (llm_agent.py:119-120)
+    assert result["retrieved_transactions_count"] == 2
+
+
+async def test_retrieval_failure_degrades():
+    async def failing(args):
+        raise RuntimeError("index down")
+
+    agent = make_agent(
+        tool_response='retrieve_transactions({"search_query": "x"})', retriever=failing
+    )
+    result = await agent.query("spending?", "u1")
+    # reference llm_agent.py:129-131: error marker recorded, answer still generated
+    assert result["response"] == "Here is my advice."
+    assert result["state"].retrieved_transactions == ["Error: index down"]
+
+
+async def test_stream_event_protocol_with_retrieval():
+    agent = make_agent(tool_response='retrieve_transactions({"search_query": "q"})')
+    events = [e async for e in agent.stream_with_status("spending?", "u1", "CTX", [])]
+    types = [e["type"] for e in events]
+    # protocol order (llm_agent.py:206-252)
+    assert types[0] == "status" and events[0]["message"] == "Starting query processing..."
+    assert "retrieval_complete" in types
+    rc = events[types.index("retrieval_complete")]
+    assert rc["count"] == 1 and rc["message"] == "Retrieved 1 transactions"
+    assert types[-1] == "complete"
+    assert events[-1]["message"] == "Query processing completed"
+    chunks = [e["content"] for e in events if e["type"] == "response_chunk"]
+    assert "".join(chunks) == "Here is my advice."
+
+
+async def test_stream_event_protocol_without_retrieval():
+    agent = make_agent(tool_response="No tool call")
+    events = [e async for e in agent.stream_with_status("hello", "u1")]
+    messages = [e.get("message") for e in events if e["type"] == "status"]
+    assert "No transaction data retrieval needed" in messages
+    assert all(e["type"] != "retrieval_complete" for e in events)
+
+
+async def test_prompt_contains_context_history_and_date():
+    tool_stub = StubGenerator(default="No tool call")
+    response_stub = StubGenerator(default="ok")
+
+    async def retriever(args):
+        return []
+
+    agent = LLMAgent(tool_stub, response_stub, retriever, SYSTEM, TOOL, today=lambda: "2026-07-29")
+    history = [ChatMessage(sender="UserMessage", message="earlier question")]
+    await agent.query("now?", "u1", "MY CONTEXT BLOCK", history)
+    assert "The current date is 2026-07-29" in tool_stub.calls[0]
+    assert "MY CONTEXT BLOCK" in tool_stub.calls[0]
+    assert "earlier question" in response_stub.calls[0]
+    assert SYSTEM in response_stub.calls[0]
+
+
+async def test_retrieved_data_lands_in_response_prompt():
+    response_stub = StubGenerator(default="ok")
+
+    async def retriever(args):
+        return ["COFFEE $4", "RENT $2000"]
+
+    agent = LLMAgent(
+        StubGenerator(default='retrieve_transactions({"search_query": "x"})'),
+        response_stub, retriever, SYSTEM, TOOL,
+    )
+    await agent.query("spending?", "u1", "CTX")
+    prompt = response_stub.calls[0]
+    assert "Retrieved Transaction Data:" in prompt
+    assert "COFFEE $4" in prompt and "RENT $2000" in prompt

@@ -1,0 +1,192 @@
+"""Full message-in → chunks-out pipeline (SURVEY §4.4): in-memory broker +
+store + stub generators, asserting the §2.4 outbound chunk schema
+byte-for-byte, plus the HTTP surface over a real TCP socket."""
+
+import asyncio
+import json
+
+import httpx
+import pytest
+
+from finchat_tpu.engine.generator import StubGenerator
+from finchat_tpu.io.kafka import InMemoryBroker, KafkaClient, Message
+from finchat_tpu.io.store import InMemoryStore
+from finchat_tpu.serve.app import build_app
+from finchat_tpu.utils.config import (
+    AI_RESPONSE_TOPIC,
+    USER_MESSAGE_TOPIC,
+    load_config,
+)
+
+CONTEXT_DOC = {"user_id": "u9", "name": "Alex", "income": 5000, "savings_goal": 800}
+
+
+def make_app(response_text="Hello there friend", tool_response="No tool call",
+             fail_response=False, watchdog=None):
+    cfg = load_config(overrides={"model.preset": "stub"})
+    if watchdog is not None:
+        cfg.engine.watchdog_seconds = watchdog
+    broker = InMemoryBroker()
+    store = InMemoryStore()
+    store.upsert_context("c1", CONTEXT_DOC)
+    store.add_user_message("c1", "How am I doing?", "u9")
+
+    response_gen = StubGenerator(default=response_text, fail_with="boom" if fail_response else None,
+                                 chunk_delay=0.001)
+    app = build_app(
+        cfg,
+        store=store,
+        kafka=KafkaClient(cfg.kafka, broker=broker),
+        tool_generator=StubGenerator(default=tool_response),
+        response_generator=response_gen,
+    )
+    return app, broker, store
+
+
+def inbound(message="How am I doing?", conversation_id="c1", **extra):
+    return {"message": message, "conversation_id": conversation_id, "user_id": "u9", **extra}
+
+
+def kafka_msg(payload):
+    return Message(USER_MESSAGE_TOPIC, payload["conversation_id"], json.dumps(payload).encode())
+
+
+def drain_json(broker):
+    return [json.loads(m.value().decode()) for m in broker.drain(AI_RESPONSE_TOPIC)]
+
+
+async def test_pipeline_chunk_schema_byte_for_byte():
+    app, broker, store = make_app(response_text="You are fine.")
+    payload = inbound(trace="t-1")
+    await app.process_message(kafka_msg(payload))
+
+    out = drain_json(broker)
+    assert len(out) >= 2
+    # every streamed chunk: reference main.py:86-93
+    for chunk in out[:-1]:
+        assert chunk["last_message"] is False
+        assert chunk["error"] is False
+        assert chunk["sender"] == "AIMessage"
+        assert chunk["type"] == "response_chunk"
+        assert chunk["conversation_id"] == "c1"
+        assert chunk["trace"] == "t-1"  # passthrough fields preserved
+    # completion marker: main.py:101-108 — message is the ORIGINAL user text
+    final = out[-1]
+    assert final["last_message"] is True
+    assert final["type"] == "complete"
+    assert final["message"] == "How am I doing?"
+    # reassembled text
+    assert "".join(c["message"] for c in out[:-1]) == "You are fine."
+    # persisted to store (main.py:126)
+    history = await store.get_history("c1")
+    assert history[-1].sender == "AIMessage"
+    assert history[-1].message == "You are fine."
+
+
+async def test_pipeline_error_chunk():
+    app, broker, _ = make_app(fail_response=True)
+    await app.process_message(kafka_msg(inbound()))
+    out = drain_json(broker)
+    assert len(out) == 1
+    err = out[0]
+    # error marker: main.py:114-121 — empty message, error=True, NO type key
+    assert err["message"] == ""
+    assert err["error"] is True
+    assert err["last_message"] is True
+    assert "type" not in err
+
+
+async def test_missing_context_drops_message():
+    app, broker, _ = make_app()
+    await app.process_message(kafka_msg(inbound(conversation_id="unknown")))
+    assert drain_json(broker) == []  # dropped silently (main.py:68-70)
+
+
+async def test_watchdog_timeout_chunk():
+    app, broker, _ = make_app(watchdog=0.05)
+    app.agent.response_generator.chunk_delay = 10.0  # hang the stream
+
+    async def run_once():
+        app._running = True
+        task = asyncio.create_task(app.consume_messages())
+        await asyncio.sleep(0.3)
+        app._running = False
+        task.cancel()
+        try:
+            await task
+        except asyncio.CancelledError:
+            pass
+
+    app.kafka.setup_consumer()
+    producer = KafkaClient(app.cfg.kafka, broker=broker)
+    producer.produce_message(USER_MESSAGE_TOPIC, "c1", inbound())
+    await run_once()
+    out = drain_json(broker)
+    assert out, "expected a timeout chunk"
+    timeout = out[-1]
+    assert timeout["message"] == "Request timed out. Please try again."
+    assert timeout["error"] is True and timeout["last_message"] is True
+
+
+async def test_full_loop_end_to_end():
+    """Produce on user_message → live consume loop → chunks on ai_response."""
+    app, broker, _ = make_app(response_text="All good.")
+    await app.start(serve_http=False)
+    try:
+        producer = KafkaClient(app.cfg.kafka, broker=broker)
+        producer.produce_message(USER_MESSAGE_TOPIC, "c1", inbound())
+        for _ in range(200):
+            out = drain_json(broker)
+            if out and out[-1].get("type") == "complete":
+                break
+            await asyncio.sleep(0.01)
+        else:
+            raise AssertionError(f"no completion marker; got {drain_json(broker)}")
+    finally:
+        await app.stop()
+
+
+async def test_http_surface():
+    app, broker, _ = make_app(response_text="Advice here.")
+    app.cfg.serve.port = 0  # ephemeral
+    app.server.port = 0
+    await app.start(serve_http=True)
+    try:
+        async with httpx.AsyncClient() as client:
+            base = f"http://127.0.0.1:{app.server.port}"
+            health = await client.get(f"{base}/health")
+            assert health.status_code == 200
+            assert health.json() == {"status": "healthy"}
+
+            chat = await client.post(f"{base}/chat", json={
+                "conversation_id": "c1", "message": "hi", "user_id": "u9",
+            })
+            assert chat.status_code == 200
+            body = chat.json()
+            assert body["response"] == "Advice here."
+            assert body["retrieved_transactions_count"] == 0
+
+            bad = await client.post(f"{base}/chat", json={"message": "hi"})
+            assert bad.status_code == 400
+
+            missing = await client.get(f"{base}/nope")
+            assert missing.status_code == 404
+
+            metrics = await client.get(f"{base}/metrics")
+            assert metrics.status_code == 200
+            assert "finchat" in metrics.text
+
+            # SSE stream carries the FULL event protocol
+            async with client.stream("POST", f"{base}/chat/stream", json={
+                "conversation_id": "c1", "message": "hi", "user_id": "u9",
+            }) as stream:
+                events = []
+                async for line in stream.aiter_lines():
+                    if line.startswith("data: "):
+                        events.append(json.loads(line[6:]))
+            types = [e["type"] for e in events]
+            assert types[0] == "status"
+            assert "response_chunk" in types
+            assert types[-1] == "complete"
+    finally:
+        await app.stop()

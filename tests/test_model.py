@@ -1,0 +1,53 @@
+"""Llama forward: shapes, causality, determinism."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from finchat_tpu.models.llama import PRESETS, forward_full, init_params
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    config = PRESETS["tiny"]
+    params = init_params(config, jax.random.key(0))
+    return config, params
+
+
+def test_forward_shapes_and_dtype(tiny):
+    config, params = tiny
+    B, S = 2, 16
+    tokens = jax.random.randint(jax.random.key(1), (B, S), 0, config.vocab_size)
+    positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+    logits = forward_full(params, tokens, positions, config=config)
+    assert logits.shape == (B, S, config.vocab_size)
+    assert logits.dtype == jnp.float32
+    assert bool(jnp.isfinite(logits).all())
+
+
+def test_causality(tiny):
+    """Perturbing token t must not change logits at positions < t."""
+    config, params = tiny
+    S = 12
+    tokens = jax.random.randint(jax.random.key(2), (1, S), 0, config.vocab_size)
+    positions = jnp.arange(S)[None]
+    base = forward_full(params, tokens, positions, config=config)
+    perturbed = tokens.at[0, 8].set((tokens[0, 8] + 1) % config.vocab_size)
+    out = forward_full(params, perturbed, positions, config=config)
+    assert jnp.abs(base[0, :8] - out[0, :8]).max() == 0.0
+    assert jnp.abs(base[0, 8:] - out[0, 8:]).max() > 0.0
+
+
+def test_deterministic(tiny):
+    config, params = tiny
+    tokens = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    positions = jnp.arange(4)[None]
+    a = forward_full(params, tokens, positions, config=config)
+    b = forward_full(params, tokens, positions, config=config)
+    assert jnp.array_equal(a, b)
+
+
+def test_presets_sane():
+    for name, c in PRESETS.items():
+        assert c.dim % c.n_heads == 0, name
+        assert c.n_heads % c.n_kv_heads == 0, name

@@ -1,0 +1,40 @@
+"""create_financial_plot — implemented (dead code in the reference)."""
+
+import json
+
+import pytest
+
+from finchat_tpu.tools.plot import PlotConfig, create_financial_plot
+
+ROWS = json.dumps([
+    {"date": "2026-01", "amount": 120.5, "category": "groceries"},
+    {"date": "2026-02", "amount": 80.0, "category": "dining"},
+    {"date": "2026-03", "amount": 200.0, "category": "groceries"},
+])
+
+
+@pytest.mark.parametrize("chart", ["line", "bar", "scatter", "histogram"])
+def test_chart_types_render(chart):
+    uri = create_financial_plot(ROWS, PlotConfig(chart_type=chart))
+    assert uri.startswith("data:image/png;base64,")
+    assert len(uri) > 500
+
+
+def test_pie_groups_by_x():
+    uri = create_financial_plot(ROWS, PlotConfig(chart_type="pie", x_field="category"))
+    assert uri.startswith("data:image/png;base64,")
+
+
+def test_unknown_chart_type():
+    with pytest.raises(ValueError, match="unknown chart_type"):
+        create_financial_plot(ROWS, PlotConfig(chart_type="sunburst"))
+
+
+def test_empty_rows():
+    with pytest.raises(ValueError):
+        create_financial_plot("[]")
+
+
+def test_missing_field():
+    with pytest.raises(ValueError, match="missing"):
+        create_financial_plot(ROWS, PlotConfig(y_field="nope"))

@@ -1,0 +1,60 @@
+"""retrieve_transactions security invariants (reference qdrant_tool.py)."""
+
+import jax
+import pytest
+
+from finchat_tpu.embed.encoder import EMBED_PRESETS, EmbeddingEncoder, init_bert_params
+from finchat_tpu.embed.index import DeviceVectorIndex
+from finchat_tpu.models.tokenizer import ByteTokenizer
+from finchat_tpu.tools.retrieval import TransactionRetriever
+
+NOW = 1_700_000_000.0
+
+
+@pytest.fixture(scope="module")
+def retriever():
+    config = EMBED_PRESETS["bge-tiny"]
+    params = init_bert_params(config, jax.random.key(0))
+    encoder = EmbeddingEncoder(config, params, ByteTokenizer())
+    index = DeviceVectorIndex(dim=config.dim)
+    r = TransactionRetriever(encoder, index, now=lambda: NOW)
+    r.upsert_transactions(
+        "alice",
+        ["GROCERY OUTLET $54.12", "RENT PAYMENT $2000", "COFFEE SHOP $4.50"],
+        dates=[NOW - 86400 * 40, NOW - 86400 * 5, NOW - 86400 * 1],
+    )
+    r.upsert_transactions("bob", ["BOB'S SECRET PURCHASE $999"], dates=[NOW - 100])
+    return r
+
+
+async def test_empty_user_id_returns_empty(retriever):
+    # qdrant_tool.py:89-91
+    assert await retriever({"search_query": "anything"}) == []
+    assert await retriever({"user_id": "", "search_query": "anything"}) == []
+
+
+async def test_user_isolation(retriever):
+    hits = await retriever({"user_id": "alice", "search_query": "purchases"})
+    assert len(hits) == 3
+    assert all("BOB" not in h for h in hits)
+
+
+async def test_time_period_filter(retriever):
+    hits = await retriever({"user_id": "alice", "search_query": "purchases", "time_period_days": 7})
+    assert len(hits) == 2  # 40-day-old grocery txn filtered out
+    assert not any("GROCERY" in h for h in hits)
+
+
+async def test_num_transactions_limit(retriever):
+    hits = await retriever({"user_id": "alice", "search_query": "purchases", "num_transactions": 1})
+    assert len(hits) == 1
+
+
+async def test_default_limit_is_10000(retriever):
+    hits = await retriever({"user_id": "alice", "search_query": "purchases", "num_transactions": None})
+    assert len(hits) == 3  # None → default 10000 (qdrant_tool.py:145)
+
+
+async def test_exception_returns_empty_list(retriever):
+    broken = TransactionRetriever(retriever.encoder, None, now=lambda: NOW)  # type: ignore
+    assert await broken({"user_id": "alice", "search_query": "x"}) == []

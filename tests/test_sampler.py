@@ -1,0 +1,50 @@
+"""Sampler: greedy, temperature, top-k, top-p semantics."""
+
+import jax
+import jax.numpy as jnp
+
+from finchat_tpu.engine.sampler import sample
+
+
+def _logits(rows):
+    return jnp.asarray(rows, jnp.float32)
+
+
+def test_greedy_when_temperature_zero():
+    logits = _logits([[0.1, 5.0, 0.2, 0.3], [9.0, 0.0, 0.0, 0.0]])
+    out = sample(logits, jax.random.key(0), jnp.zeros(2), jnp.ones(2), jnp.zeros(2, jnp.int32))
+    assert out.tolist() == [1, 0]
+
+
+def test_top_k_restricts_support():
+    logits = _logits([[10.0, 9.0, -50.0, -50.0]])
+    for seed in range(20):
+        out = sample(logits, jax.random.key(seed), jnp.ones(1) * 5.0, jnp.ones(1), jnp.asarray([2], jnp.int32))
+        assert int(out[0]) in (0, 1)
+
+
+def test_top_p_restricts_support():
+    # token 0 has ~98% mass; top_p=0.5 keeps only it
+    logits = _logits([[10.0, 6.0, 5.0, 1.0]])
+    for seed in range(20):
+        out = sample(logits, jax.random.key(seed), jnp.ones(1), jnp.asarray([0.5]), jnp.zeros(1, jnp.int32))
+        assert int(out[0]) == 0
+
+
+def test_mixed_batch_greedy_and_sampled():
+    logits = _logits([[0.0, 8.0, 0.0], [3.0, 3.0, 3.0]])
+    out = sample(
+        logits, jax.random.key(3),
+        jnp.asarray([0.0, 1.0]), jnp.ones(2), jnp.zeros(2, jnp.int32),
+    )
+    assert int(out[0]) == 1
+    assert 0 <= int(out[1]) < 3
+
+
+def test_sampled_distribution_roughly_matches():
+    logits = _logits([[2.0, 1.0, 0.0]])
+    counts = [0, 0, 0]
+    for seed in range(300):
+        out = sample(logits, jax.random.key(seed), jnp.ones(1), jnp.ones(1), jnp.zeros(1, jnp.int32))
+        counts[int(out[0])] += 1
+    assert counts[0] > counts[1] > counts[2] > 0

@@ -1,0 +1,58 @@
+"""Byte tokenizer, UTF-8-safe streaming detokenizer, chat template."""
+
+from finchat_tpu.io.schemas import ChatMessage
+from finchat_tpu.models.tokenizer import ByteTokenizer, IncrementalDecoder, render_chat
+
+
+def test_byte_roundtrip():
+    tok = ByteTokenizer()
+    text = "Penny saves $1,500/mo — 良い 🎉"
+    assert tok.decode(tok.encode(text)) == text
+
+
+def test_bos_prepend():
+    tok = ByteTokenizer()
+    ids = tok.encode("a", add_bos=True)
+    assert ids[0] == tok.bos_id and ids[1:] == [ord("a")]
+
+
+def test_incremental_decoder_never_tears_multibyte():
+    tok = ByteTokenizer()
+    text = "héllo 🎉 良"
+    ids = tok.encode(text)
+    dec = IncrementalDecoder(tok)
+    out = ""
+    for t in ids:
+        piece = dec.push(t)
+        assert "�" not in piece
+        out += piece
+    out += dec.flush()
+    assert out == text
+
+
+def test_incremental_decoder_ignores_specials():
+    tok = ByteTokenizer()
+    dec = IncrementalDecoder(tok)
+    assert dec.push(tok.eos_id) == ""
+    assert dec.push(ord("x")) == "x"
+
+
+def test_incremental_decoder_garbage_does_not_stall():
+    tok = ByteTokenizer()
+    dec = IncrementalDecoder(tok)
+    # 0xFF is never valid UTF-8; a run of them must flush as replacements
+    out = "".join(dec.push(0xFF) for _ in range(6))
+    assert "�" in out  # emitted, not buffered forever
+
+
+def test_render_chat_structure():
+    history = [
+        ChatMessage(sender="UserMessage", message="hi"),
+        ChatMessage(sender="AIMessage", message="hello!"),
+    ]
+    prompt = render_chat("SYSTEM RULES", "MY CONTEXT", history, "what now?")
+    # system block contains system_prompt then context (llm_agent.py:47-51)
+    assert prompt.index("SYSTEM RULES") < prompt.index("MY CONTEXT")
+    assert prompt.index("MY CONTEXT") < prompt.index("hi")
+    assert prompt.index("hi") < prompt.index("hello!") < prompt.index("what now?")
+    assert prompt.rstrip().endswith("<|assistant|>")

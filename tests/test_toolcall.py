@@ -1,0 +1,63 @@
+"""Tool-decision parsing; the tool_prompt few-shots are the test cases
+(SURVEY §7.3 hard part 5)."""
+
+from finchat_tpu.agent.toolcall import parse_tool_decision
+
+
+def test_no_tool_literal():
+    assert parse_tool_decision("No tool call") is None
+    assert parse_tool_decision("  no tool call  ") is None
+    assert parse_tool_decision("") is None
+
+
+def test_fewshot_groceries():
+    # tool_prompt.txt example 1
+    out = parse_tool_decision(
+        'Call tool: retrieve_transactions({"search_query": "grocery store purchases", "num_transactions": 20})'
+    )
+    assert out is not None
+    assert out.args["search_query"] == "grocery store purchases"
+    assert out.args["num_transactions"] == 20
+
+
+def test_fewshot_time_period():
+    # tool_prompt.txt example 2
+    out = parse_tool_decision(
+        'retrieve_transactions({"search_query": "all purchases", "time_period_days": 2})'
+    )
+    assert out is not None
+    assert out.args["time_period_days"] == 2
+    assert "num_transactions" not in out.args
+
+
+def test_user_id_from_model_is_dropped():
+    out = parse_tool_decision(
+        'retrieve_transactions({"search_query": "x", "user_id": "attacker"})'
+    )
+    assert out is not None
+    assert "user_id" not in out.args
+
+
+def test_num_transactions_clamped():
+    out = parse_tool_decision('retrieve_transactions({"num_transactions": 999999})')
+    assert out.args["num_transactions"] == 10_000
+    out = parse_tool_decision('retrieve_transactions({"num_transactions": -3})')
+    assert out.args["num_transactions"] == 1
+
+
+def test_malformed_json_degrades_to_defaults():
+    out = parse_tool_decision("retrieve_transactions({search_query: broken")
+    assert out is not None
+    assert out.args["search_query"] == "recent transactions"
+
+
+def test_prose_without_tool_name_is_no_call():
+    assert parse_tool_decision("I think we should check the weather.") is None
+
+
+def test_multiline_json():
+    out = parse_tool_decision(
+        'retrieve_transactions({\n  "search_query": "rent",\n  "time_period_days": 90\n})'
+    )
+    assert out.args["search_query"] == "rent"
+    assert out.args["time_period_days"] == 90
