@@ -194,16 +194,30 @@ class InferenceEngine:
     (engine/scheduler.py) drives the same step functions for many sequences.
     """
 
-    def __init__(self, config: LlamaConfig, params: dict[str, Any], engine_cfg: EngineConfig):
+    def __init__(self, config: LlamaConfig, params: dict[str, Any], engine_cfg: EngineConfig,
+                 mesh=None):
         self.config = config
-        self.params = params
         self.engine_cfg = engine_cfg
         self.page_size = engine_cfg.page_size
         self.max_pages_per_seq = min(
             engine_cfg.num_pages - 1,
             -(-engine_cfg.max_seq_len // engine_cfg.page_size),
         )
-        self.state = create_state(config, engine_cfg, self.max_pages_per_seq)
+        self.mesh = mesh
+        state = create_state(config, engine_cfg, self.max_pages_per_seq)
+        if mesh is not None:
+            # TP placement: params sharded Megatron-style, KV pages sharded
+            # over KV heads on the model axis; XLA propagates the rest.
+            from finchat_tpu.parallel.sharding import (
+                llama_param_shardings,
+                shard_decode_state,
+                shard_params,
+            )
+
+            params = shard_params(params, llama_param_shardings(mesh))
+            state = shard_decode_state(state, mesh)
+        self.params = params
+        self.state = state
 
     # --- low-level ops used by the scheduler ----------------------------
     def set_page_table_row(self, slot: int, pages: list[int]) -> None:

@@ -165,6 +165,7 @@ def forward(
     config: LlamaConfig,
     attention: AttentionFn,
     cache: Any = None,  # pytree whose leaves have leading axis n_layers, or None
+    remat: bool = False,  # checkpoint each scanned layer (training)
 ) -> tuple[Array, Any]:
     """Run the decoder; returns (logits[B,S,vocab] fp32, new_cache)."""
     c = config
@@ -178,6 +179,11 @@ def forward(
             positions=positions, config=c, attention=attention,
         )
         return x, new_layer_cache
+
+    if remat:
+        # per-layer remat: backward recomputes one layer at a time, so live
+        # residuals stay O(one layer) instead of O(n_layers)
+        scan_body = jax.checkpoint(scan_body)
 
     layer_ids = jnp.arange(c.n_layers)
     cacheless = cache is None

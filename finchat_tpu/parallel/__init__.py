@@ -1,0 +1,14 @@
+from finchat_tpu.parallel.mesh import MeshSpec, build_mesh
+from finchat_tpu.parallel.sharding import (
+    llama_param_shardings,
+    decode_state_shardings,
+    shard_params,
+)
+
+__all__ = [
+    "MeshSpec",
+    "build_mesh",
+    "llama_param_shardings",
+    "decode_state_shardings",
+    "shard_params",
+]

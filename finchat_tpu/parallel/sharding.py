@@ -1,0 +1,94 @@
+"""Sharding rules: logical param/state layout → mesh placement.
+
+Megatron-style TP expressed as GSPMD constraints — we annotate the weights
+and let XLA's SPMD partitioner insert the collectives (psum after
+row-parallel matmuls etc.), instead of hand-writing NCCL calls the way
+GPU frameworks do:
+
+- attention q/k/v projections column-parallel over heads (``model`` axis),
+  output projection row-parallel → one all-reduce;
+- MLP gate/up column-parallel, down row-parallel → one all-reduce;
+- embeddings + lm_head feature/vocab sharded; norms replicated;
+- KV-cache pages sharded over KV heads on ``model`` (matches the k/v
+  projection sharding, so cache writes are local);
+- batch-bearing engine state sharded on ``data`` where useful; page tables
+  and lengths replicated (they are tiny and host-updated).
+
+Parity note: the reference has no parallelism to mirror (SURVEY §2.3); this
+module IS the new framework surface specified there.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from finchat_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def llama_param_shardings(mesh: Mesh) -> dict[str, Any]:
+    """PartitionSpec tree matching models/llama.py:init_params layout.
+
+    Leading axis of every ``layers`` leaf is the stacked layer axis — never
+    sharded (it is scanned over)."""
+    def ns(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    return {
+        # replicated: a feature- or vocab-sharded table makes the token
+        # gather's output sharding ambiguous under GSPMD (needs an explicit
+        # out_sharding at the lookup); revisit when embed HBM matters.
+        "embed": ns(None, None),
+        "layers": {
+            "attn_q": ns(None, None, "model"),  # [L, D, H*hd] column-parallel
+            "attn_k": ns(None, None, "model"),
+            "attn_v": ns(None, None, "model"),
+            "attn_o": ns(None, "model", None),  # [L, H*hd, D] row-parallel
+            "mlp_gate": ns(None, None, "model"),
+            "mlp_up": ns(None, None, "model"),
+            "mlp_down": ns(None, "model", None),
+            "ln_attn": ns(None, None),
+            "ln_mlp": ns(None, None),
+        },
+        "norm": ns(None),
+        "lm_head": ns(None, "model"),  # vocab-sharded logits
+    }
+
+
+def decode_state_shardings(mesh: Mesh) -> dict[str, Any]:
+    """Shardings for engine.DecodeState fields (see engine/engine.py)."""
+    def ns(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    return {
+        # [L, pages, page_size, Hkv, hd] — KV heads on the model axis
+        "k_pages": ns(None, None, None, "model", None),
+        "v_pages": ns(None, None, None, "model", None),
+        "page_table": ns(None, None),
+        "context_lens": ns(None),
+        "last_tokens": ns(None),
+        "rng": ns(),
+    }
+
+
+def shard_params(params: dict[str, Any], shardings: dict[str, Any]) -> dict[str, Any]:
+    """Place a (host or single-device) param tree onto the mesh. Sharding
+    entries with no matching param (e.g. ``lm_head`` under tied embeddings)
+    are ignored."""
+    pruned = {k: v for k, v in shardings.items() if k in params}
+    return jax.tree.map(jax.device_put, params, pruned)
+
+
+def shard_decode_state(state, mesh: Mesh):
+    """Place an engine DecodeState onto the mesh."""
+    import dataclasses
+
+    sh = decode_state_shardings(mesh)
+    return dataclasses.replace(
+        state,
+        **{f: jax.device_put(getattr(state, f), sh[f]) for f in sh},
+    )

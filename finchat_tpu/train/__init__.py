@@ -1,0 +1,3 @@
+from finchat_tpu.train.train_step import TrainState, make_train_step, init_train_state
+
+__all__ = ["TrainState", "make_train_step", "init_train_state"]
