@@ -1,0 +1,120 @@
+"""Headline benchmark: continuous-batch decode throughput (tok/s/chip).
+
+Measures the paged inference engine end-to-end — chunked prefill into the
+paged KV cache, then timed batched decode steps (attention over paged KV,
+in-jit sampling) — against the BASELINE north star of 2,000 decode tok/s/chip
+(BASELINE.md; reference publishes no numbers of its own, SURVEY §6).
+
+Prints ONE JSON line:
+  {"metric": "decode_tok_s_per_chip", "value": N, "unit": "tok/s/chip",
+   "vs_baseline": N / 2000, ...detail fields}
+
+Model selection is hardware-aware: a TinyLlama-1.1B-shaped random-weight
+decoder on TPU (the largest BASELINE config that fits one chip's HBM), the
+"mini" debug config on CPU so the benchmark always runs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BASELINE_TOK_S_PER_CHIP = 2000.0  # BASELINE.md north star
+
+
+def run(preset: str, batch: int, prompt_len: int, steps: int, warmup: int,
+        page_size: int, max_seq_len: int) -> dict:
+    from finchat_tpu.engine.engine import InferenceEngine
+    from finchat_tpu.engine.kv_cache import pages_needed
+    from finchat_tpu.models.llama import PRESETS, init_params
+    from finchat_tpu.utils.config import EngineConfig
+
+    config = PRESETS[preset]
+    pages_per_seq = pages_needed(max_seq_len, page_size)
+    engine_cfg = EngineConfig(
+        max_seqs=batch,
+        page_size=page_size,
+        # every slot fully paged + trash page, with some slack
+        num_pages=batch * pages_per_seq + 8,
+        max_seq_len=max_seq_len,
+        prefill_chunk=max(prompt_len, 128),
+    )
+
+    params = init_params(config, jax.random.key(0))
+    engine = InferenceEngine(config, params, engine_cfg)
+
+    # assign pages + prefill a random prompt into every slot
+    rng = np.random.default_rng(0)
+    next_page = 1  # page 0 is the trash page
+    t_prefill0 = time.perf_counter()
+    for slot in range(batch):
+        engine.set_page_table_row(slot, list(range(next_page, next_page + pages_per_seq)))
+        next_page += pages_per_seq
+        prompt = rng.integers(1, config.vocab_size, size=prompt_len).tolist()
+        engine.prefill(slot, prompt)
+    np.asarray(engine.state.context_lens)  # host fetch = execution barrier
+    prefill_s = time.perf_counter() - t_prefill0
+
+    active = jnp.ones((batch,), bool)
+    temperature = jnp.full((batch,), 0.5, jnp.float32)
+    top_p = jnp.ones((batch,), jnp.float32)
+    top_k = jnp.zeros((batch,), jnp.int32)
+
+    # Sync via host fetch of the sampled tokens (a [batch] int32 array):
+    # block_until_ready is not a reliable execution barrier on every backend
+    # (observed no-op over the axon TPU tunnel), while a device→host copy of
+    # the step output forces the whole dependent chain.
+    for _ in range(max(warmup, 1)):  # compile + steady-state warmup
+        tokens = engine.decode(active, temperature, top_p, top_k)
+    np.asarray(tokens)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        tokens = engine.decode(active, temperature, top_p, top_k)
+    np.asarray(tokens)
+    elapsed = time.perf_counter() - t0
+
+    tok_s = batch * steps / elapsed
+    return {
+        "metric": "decode_tok_s_per_chip",
+        "value": round(tok_s, 1),
+        "unit": "tok/s/chip",
+        "vs_baseline": round(tok_s / BASELINE_TOK_S_PER_CHIP, 3),
+        "model": preset,
+        "batch": batch,
+        "prompt_len": prompt_len,
+        "decode_steps": steps,
+        "step_ms": round(1000 * elapsed / steps, 2),
+        "prefill_s": round(prefill_s, 2),
+        "platform": jax.devices()[0].platform,
+        "device": str(jax.devices()[0]),
+    }
+
+
+def main() -> None:
+    on_tpu = jax.devices()[0].platform == "tpu"
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--preset", default="tinyllama-1.1b" if on_tpu else "mini")
+    p.add_argument("--batch", type=int, default=32 if on_tpu else 8)
+    p.add_argument("--prompt-len", type=int, default=128)
+    p.add_argument("--steps", type=int, default=128 if on_tpu else 16)
+    p.add_argument("--warmup", type=int, default=8 if on_tpu else 2)
+    p.add_argument("--page-size", type=int, default=128)
+    p.add_argument("--max-seq-len", type=int, default=1024)
+    args = p.parse_args()
+
+    result = run(
+        args.preset, args.batch, args.prompt_len, args.steps, args.warmup,
+        args.page_size, args.max_seq_len,
+    )
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

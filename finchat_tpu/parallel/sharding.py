@@ -75,12 +75,56 @@ def decode_state_shardings(mesh: Mesh) -> dict[str, Any]:
     }
 
 
+# Tensors above this size refuse to silently replicate when their sharded
+# dim doesn't divide the mesh axis — at that scale replication means HBM
+# blow-up on real checkpoints and the config error must fail fast. Small
+# (debug-model) tensors replicate with a warning so tiny presets run on any
+# mesh.
+_REPLICATE_LIMIT_BYTES = 256 * 1024 * 1024
+
+
+def _fit_sharding(
+    sharding: NamedSharding, shape: tuple[int, ...], nbytes: int
+) -> NamedSharding:
+    """Drop (replicate) any spec axis whose mesh extent does not divide the
+    array dimension; raise instead when the tensor is too large to replicate
+    safely. Production-sized configs divide evenly and are untouched."""
+    mesh = sharding.mesh
+    spec = list(sharding.spec) + [None] * (len(shape) - len(sharding.spec))
+    fitted = []
+    for dim, axes in zip(shape, spec):
+        if axes is None:
+            fitted.append(None)
+            continue
+        extent = 1
+        for a in (axes if isinstance(axes, tuple) else (axes,)):
+            extent *= mesh.shape[a]
+        if dim % extent:
+            if nbytes > _REPLICATE_LIMIT_BYTES:
+                raise ValueError(
+                    f"dim of size {dim} (tensor shape {shape}, {nbytes} bytes) is not "
+                    f"divisible by mesh axes {axes!r} = {extent}; refusing to replicate "
+                    "a tensor this large — fix the mesh/model config"
+                )
+            logger.warning(
+                "replicating dim of size %d (not divisible by mesh axes %r = %d)",
+                dim, axes, extent,
+            )
+            fitted.append(None)
+        else:
+            fitted.append(axes)
+    return NamedSharding(mesh, P(*fitted))
+
+
 def shard_params(params: dict[str, Any], shardings: dict[str, Any]) -> dict[str, Any]:
     """Place a (host or single-device) param tree onto the mesh. Sharding
     entries with no matching param (e.g. ``lm_head`` under tied embeddings)
-    are ignored."""
+    are ignored; non-dividing dims are replicated."""
     pruned = {k: v for k, v in shardings.items() if k in params}
-    return jax.tree.map(jax.device_put, params, pruned)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, _fit_sharding(s, x.shape, x.nbytes)),
+        params, pruned,
+    )
 
 
 def shard_decode_state(state, mesh: Mesh):
@@ -90,5 +134,11 @@ def shard_decode_state(state, mesh: Mesh):
     sh = decode_state_shardings(mesh)
     return dataclasses.replace(
         state,
-        **{f: jax.device_put(getattr(state, f), sh[f]) for f in sh},
+        **{
+            f: jax.device_put(
+                getattr(state, f),
+                _fit_sharding(sh[f], getattr(state, f).shape, getattr(state, f).nbytes),
+            )
+            for f in sh
+        },
     )

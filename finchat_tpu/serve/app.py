@@ -74,7 +74,20 @@ def build_generators(cfg: AppConfig) -> tuple[TextGenerator, TextGenerator, Cont
     else:
         logger.warning("no checkpoint configured; using RANDOM weights (preset=%s)", cfg.model.preset)
         params = init_params(config, jax.random.key(cfg.model.seed))
-    engine = InferenceEngine(config, params, cfg.engine)
+    from finchat_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    spec = MeshSpec.from_config(cfg.mesh)
+    sizes = (spec.data, spec.seq, spec.expert, spec.model)
+    fixed = 1
+    for s in sizes:
+        if s != -1:
+            fixed *= s
+    # -1 axes absorb all devices; a fully fixed mesh uses exactly its own
+    # product (so e.g. an explicit all-1 config opts out of parallelism even
+    # on a multi-chip host, and a 4-chip mesh config works on an 8-chip host)
+    n_mesh = jax.device_count() if -1 in sizes else fixed
+    mesh = build_mesh(spec, devices=jax.devices()[:n_mesh]) if n_mesh > 1 else None
+    engine = InferenceEngine(config, params, cfg.engine, mesh=mesh)
     scheduler = ContinuousBatchingScheduler(engine, eos_id=tokenizer.eos_id)
     generator = EngineGenerator(scheduler, tokenizer)
     return generator, generator, scheduler, tokenizer
