@@ -1,0 +1,145 @@
+"""Load/latency harness: N concurrent sessions through the scheduler.
+
+SURVEY §4.6 — measures the BASELINE north-star serving metrics end to end
+(submit → chunked prefill → continuous-batch decode → token events):
+
+- p50/p95 TTFT (time to first token) per session,
+- aggregate decode throughput (tok/s) while the batch is saturated,
+- per-session generation latency.
+
+Runs anywhere: random-weight model, byte tokenizer, no external services —
+the scheduler and engine under test are the production objects. On TPU use
+``--preset tinyllama-1.1b --sessions 64`` for the BASELINE config-4 shape.
+
+Usage:
+  python benchmarks/load_harness.py [--preset mini] [--sessions 16]
+      [--prompt-len 128] [--new-tokens 64]
+
+Prints one JSON line (same contract as bench.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+BASELINE_TTFT_P50_S = 0.300  # BASELINE.md: p50 TTFT <= 300 ms
+
+
+async def run_load(
+    preset: str, sessions: int, prompt_len: int, new_tokens: int,
+    page_size: int, prefill_chunk: int,
+) -> dict:
+    from finchat_tpu.engine.engine import InferenceEngine
+    from finchat_tpu.engine.generator import EngineGenerator
+    from finchat_tpu.engine.sampler import SamplingParams
+    from finchat_tpu.engine.scheduler import ContinuousBatchingScheduler
+    from finchat_tpu.models.llama import PRESETS, init_params
+    from finchat_tpu.models.tokenizer import ByteTokenizer
+    from finchat_tpu.utils.config import EngineConfig
+
+    config = PRESETS[preset]
+    max_len = prompt_len + new_tokens
+    pages_per_seq = -(-max_len // page_size)
+    engine_cfg = EngineConfig(
+        max_seqs=sessions,
+        page_size=page_size,
+        num_pages=sessions * pages_per_seq + 8,
+        max_seq_len=max_len,
+        prefill_chunk=prefill_chunk,
+        max_new_tokens=new_tokens,
+    )
+    tok = ByteTokenizer()
+    params = init_params(config, jax.random.key(0))
+    engine = InferenceEngine(config, params, engine_cfg)
+    scheduler = ContinuousBatchingScheduler(engine, eos_id=tok.eos_id)
+    gen = EngineGenerator(scheduler, tok)
+
+    rng = np.random.default_rng(0)
+    prompts = [
+        "".join(chr(int(c)) for c in rng.integers(97, 122, size=prompt_len))
+        for _ in range(sessions)
+    ]
+    sampling = SamplingParams(temperature=0.5, max_new_tokens=new_tokens)
+
+    ttfts: list[float] = []
+    finishes: list[float] = []
+    tokens_out = [0] * sessions
+
+    async def one_session(i: int) -> None:
+        t0 = time.perf_counter()
+        first = None
+        async for _ in gen.stream(prompts[i], sampling):
+            if first is None:
+                first = time.perf_counter() - t0
+            tokens_out[i] += 1
+        ttfts.append(first if first is not None else float("nan"))
+        finishes.append(time.perf_counter() - t0)
+
+    await scheduler.start()
+    t_all0 = time.perf_counter()
+    try:
+        await asyncio.gather(*(one_session(i) for i in range(sessions)))
+    finally:
+        await scheduler.stop()
+    wall = time.perf_counter() - t_all0
+
+    total_tokens = sum(tokens_out)
+    ttfts_a = np.asarray(sorted(ttfts))
+    p50 = float(np.percentile(ttfts_a, 50))
+    return {
+        "metric": "ttft_p50_seconds",
+        "value": round(p50, 4),
+        "unit": "s",
+        "vs_baseline": round(BASELINE_TTFT_P50_S / max(p50, 1e-9), 3),  # >1 = better
+        "ttft_p95_s": round(float(np.percentile(ttfts_a, 95)), 4),
+        "throughput_tok_s": round(total_tokens / wall, 1),
+        "sessions": sessions,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "total_tokens": total_tokens,
+        "wall_s": round(wall, 2),
+        "model": preset,
+        "platform": jax.devices()[0].platform,
+    }
+
+
+def main() -> None:
+    import os
+
+    # --platform cpu must act before any backend query: the axon register
+    # hook hijacks get_backend regardless of JAX_PLATFORMS env, so the only
+    # reliable route is jax.config before first device touch.
+    if "--platform" in os.sys.argv:
+        platform = os.sys.argv[os.sys.argv.index("--platform") + 1]
+        jax.config.update("jax_platforms", platform)
+    on_tpu = jax.devices()[0].platform == "tpu"
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--platform", default=None, help="jax platform override (e.g. cpu)")
+    p.add_argument("--preset", default="tinyllama-1.1b" if on_tpu else "mini")
+    p.add_argument("--sessions", type=int, default=64 if on_tpu else 8)
+    p.add_argument("--prompt-len", type=int, default=128)
+    p.add_argument("--new-tokens", type=int, default=64 if on_tpu else 16)
+    p.add_argument("--page-size", type=int, default=128)
+    p.add_argument("--prefill-chunk", type=int, default=128)
+    args = p.parse_args()
+    result = asyncio.run(
+        run_load(
+            args.preset, args.sessions, args.prompt_len, args.new_tokens,
+            args.page_size, args.prefill_chunk,
+        )
+    )
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

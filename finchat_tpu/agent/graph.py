@@ -97,8 +97,12 @@ class LLMAgent:
         self.tool_prompt = tool_prompt
         # temperature 0.5 both roles (reference llm_agent.py:37,44); the
         # decision head is short and greedy-leaning would also be defensible,
-        # but parity wins.
-        self.tool_sampling = tool_sampling or SamplingParams(temperature=0.5, max_new_tokens=96)
+        # but parity wins. The decision output is grammar-constrained
+        # (agent/constrained.py) — the on-TPU replacement for Gemini's
+        # function-calling reliability.
+        self.tool_sampling = tool_sampling or SamplingParams(
+            temperature=0.5, max_new_tokens=96, grammar="tool_call"
+        )
         self.response_sampling = response_sampling or SamplingParams(temperature=0.5)
         self.today = today
         self.graph = self._build_graph()

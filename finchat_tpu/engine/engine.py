@@ -27,12 +27,11 @@ from jax import Array
 
 from finchat_tpu.engine.kv_cache import (
     PagedKVCache,
-    gather_kv,
     scatter_kv_chunk,
 )
 from finchat_tpu.engine.sampler import sample
 from finchat_tpu.models.llama import LlamaConfig, forward
-from finchat_tpu.ops.refs import mha_reference
+from finchat_tpu.ops.dispatch import paged_attention
 from finchat_tpu.utils.config import EngineConfig
 from finchat_tpu.utils.logging import get_logger
 
@@ -44,7 +43,7 @@ logger = get_logger(__name__)
 class DecodeState:
     """Device-resident engine state (a pytree; all leaves are arrays)."""
 
-    k_pages: Array  # [L, P, page_size, Hkv, hd]
+    k_pages: Array  # [L, P, Hkv, page_size, hd]
     v_pages: Array
     page_table: Array  # [max_seqs, max_pages_per_seq] int32 (0 = trash)
     context_lens: Array  # [max_seqs] int32 — tokens whose KV is cached
@@ -66,7 +65,7 @@ def create_state(
     )
 
 
-def _paged_attention_fn(page_table: Array, start_pos: Array, n_valid: Array, page_size: int):
+def _paged_attention_fn(page_table: Array, start_pos: Array, n_valid: Array, page_size: int, attn_backend: str):
     """Build the model's attention callback for paged prefill/decode.
 
     ``page_table`` [B, max_pages], ``start_pos`` [B] (absolute position of
@@ -77,19 +76,16 @@ def _paged_attention_fn(page_table: Array, start_pos: Array, n_valid: Array, pag
     def attention(q: Array, k: Array, v: Array, layer_cache: Any, layer_idx: Array):
         k_l, v_l = layer_cache
         k_l, v_l = scatter_kv_chunk(k_l, v_l, k, v, page_table, start_pos, n_valid, page_size)
-        k_all, v_all = gather_kv(k_l, v_l, page_table, page_size)
-        out = mha_reference(
-            q, k_all, v_all,
-            causal=True,
-            q_offset=start_pos,
-            kv_len=start_pos + n_valid,
+        out = paged_attention(
+            q, k_l, v_l, page_table, start_pos, start_pos + n_valid,
+            page_size=page_size, backend=attn_backend,
         )
         return out, (k_l, v_l)
 
     return attention
 
 
-@partial(jax.jit, static_argnames=("config", "page_size"), donate_argnums=(1,))
+@partial(jax.jit, static_argnames=("config", "page_size", "attn_backend"), donate_argnums=(1,))
 def prefill_step(
     params: dict[str, Any],
     state: DecodeState,
@@ -100,13 +96,14 @@ def prefill_step(
     *,
     config: LlamaConfig,
     page_size: int,
+    attn_backend: str = "ref",
 ) -> tuple[DecodeState, Array]:
     """Run one prefill chunk; returns (state, last-valid-token logits [vocab])."""
     C = tokens.shape[1]
     positions = (start_pos + jnp.arange(C))[None, :]  # [1, C]
     page_row = jax.lax.dynamic_slice_in_dim(state.page_table, slot, 1, axis=0)  # [1, max_pages]
 
-    attention = _paged_attention_fn(page_row, start_pos[None], n_valid[None], page_size)
+    attention = _paged_attention_fn(page_row, start_pos[None], n_valid[None], page_size, attn_backend)
     logits, (k_pages, v_pages) = forward(
         params, tokens, positions,
         config=config, attention=attention,
@@ -141,7 +138,11 @@ def commit_first_token(
     return new_state, token
 
 
-@partial(jax.jit, static_argnames=("config", "page_size"), donate_argnums=(1,))
+@partial(
+    jax.jit,
+    static_argnames=("config", "page_size", "attn_backend", "return_logits"),
+    donate_argnums=(1,),
+)
 def decode_step(
     params: dict[str, Any],
     state: DecodeState,
@@ -152,19 +153,25 @@ def decode_step(
     *,
     config: LlamaConfig,
     page_size: int,
-) -> tuple[DecodeState, Array]:
+    attn_backend: str = "ref",
+    return_logits: bool = False,
+) -> tuple[DecodeState, Array, Array | None]:
     """One decode step for ALL slots; returns (state, next_tokens [max_seqs]).
 
     Each active slot's ``last_token`` KV is appended at ``context_lens`` and
     the next token sampled from its logits. Inactive slots write to the
     trash page and their sampled tokens are ignored by the host.
+
+    ``return_logits=True`` additionally returns the step logits [B, vocab]
+    (fp32) — the host-side path for grammar-constrained sampling
+    (agent/constrained.py), which overrides ``last_tokens`` afterwards.
     """
     B = state.last_tokens.shape[0]
     tokens = state.last_tokens[:, None]  # [B, 1]
     positions = state.context_lens[:, None]  # [B, 1]
     n_valid = active.astype(jnp.int32)  # [B]
 
-    attention = _paged_attention_fn(state.page_table, state.context_lens, n_valid, page_size)
+    attention = _paged_attention_fn(state.page_table, state.context_lens, n_valid, page_size, attn_backend)
     logits, (k_pages, v_pages) = forward(
         params, tokens, positions,
         config=config, attention=attention,
@@ -183,7 +190,7 @@ def decode_step(
         last_tokens=jnp.where(active, next_tokens, state.last_tokens),
         rng=rng,
     )
-    return new_state, next_tokens
+    return new_state, next_tokens, (step_logits if return_logits else None)
 
 
 class InferenceEngine:
@@ -195,8 +202,11 @@ class InferenceEngine:
     """
 
     def __init__(self, config: LlamaConfig, params: dict[str, Any], engine_cfg: EngineConfig,
-                 mesh=None):
+                 mesh=None, attn_backend: str | None = None):
+        from finchat_tpu.ops.dispatch import attention_backend
+
         self.config = config
+        self.attn_backend = attn_backend or attention_backend()
         self.engine_cfg = engine_cfg
         self.page_size = engine_cfg.page_size
         self.max_pages_per_seq = min(
@@ -227,6 +237,13 @@ class InferenceEngine:
             self.state, page_table=self.state.page_table.at[slot].set(row)
         )
 
+    def set_last_token(self, slot: int, token: int) -> None:
+        """Override a slot's next decode input — used by grammar-constrained
+        sampling after a host-side pick replaces the device-sampled token."""
+        self.state = dataclasses.replace(
+            self.state, last_tokens=self.state.last_tokens.at[slot].set(token)
+        )
+
     def reset_slot(self, slot: int) -> None:
         self.state = dataclasses.replace(
             self.state,
@@ -250,14 +267,16 @@ class InferenceEngine:
                 self.params, self.state, tokens,
                 jnp.int32(slot), jnp.int32(start), jnp.int32(n_valid),
                 config=self.config, page_size=self.page_size,
+                attn_backend=self.attn_backend,
             )
             start += n_valid
         assert last_logits is not None, "empty prompt"
         return last_logits
 
-    def decode(self, active, temperature, top_p, top_k) -> Array:
-        self.state, next_tokens = decode_step(
+    def decode(self, active, temperature, top_p, top_k, return_logits: bool = False):
+        self.state, next_tokens, logits = decode_step(
             self.params, self.state, active, temperature, top_p, top_k,
             config=self.config, page_size=self.page_size,
+            attn_backend=self.attn_backend, return_logits=return_logits,
         )
-        return next_tokens
+        return (next_tokens, logits) if return_logits else next_tokens

@@ -38,11 +38,26 @@ class EngineGenerator:
         self.scheduler = scheduler
         self.tokenizer = tokenizer
         self._ids = itertools.count()
+        self._grammar_vocabs: dict[str, object] = {}  # grammar name -> GrammarVocab
+
+    async def _make_constraint(self, grammar: str):
+        from finchat_tpu.agent.constrained import GrammarVocab, TokenConstraint
+
+        if grammar != "tool_call":
+            raise ValueError(f"unknown grammar {grammar!r}")
+        vocab = self._grammar_vocabs.get(grammar)
+        if vocab is None:
+            # one-time O(vocab) build (token decode + dense DFA table): off
+            # the event loop so in-flight decodes aren't stalled
+            vocab = await asyncio.to_thread(GrammarVocab.for_tokenizer, self.tokenizer)
+            self._grammar_vocabs[grammar] = vocab
+        return TokenConstraint(vocab)
 
     async def stream(self, prompt: str, sampling: SamplingParams) -> AsyncIterator[str]:
         prompt_ids = self.tokenizer.encode(prompt, add_bos=True)
         seq_id = f"seq-{next(self._ids)}"
-        handle = await self.scheduler.submit(seq_id, prompt_ids, sampling)
+        constraint = await self._make_constraint(sampling.grammar) if sampling.grammar else None
+        handle = await self.scheduler.submit(seq_id, prompt_ids, sampling, constraint=constraint)
         decoder = IncrementalDecoder(self.tokenizer)
         try:
             while True:

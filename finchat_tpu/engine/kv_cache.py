@@ -6,7 +6,10 @@ retrieved transactions, reference qdrant_tool.py:145 / llm_agent.py:234-236)
 servable on fixed TPU HBM:
 
 - Device side: ``k_pages``/``v_pages`` shaped ``[n_layers, num_pages,
-  page_size, n_kv_heads, head_dim]``. Physical page 0 is a TRASH page —
+  n_kv_heads, page_size, head_dim]`` — head-major, so one head's page is a
+  contiguous ``(page_size, head_dim)`` tile, the unit the Pallas paged-
+  attention kernel DMAs (Mosaic wants the trailing two dims tile-aligned).
+  Physical page 0 is a TRASH page —
   writes from padding lanes and inactive slots are redirected there, which
   keeps every jitted step a fixed-shape scatter with no host branching.
 - Host side: ``PageAllocator`` — a free list with ownership tracking and the
@@ -36,14 +39,14 @@ class PagedKVCache:
     """Device-side paged cache tensors (a pytree; leaves have leading L axis
     so the model's ``lax.scan`` over layers slices one layer's pages)."""
 
-    k_pages: Any  # [L, P, page_size, Hkv, head_dim]
-    v_pages: Any  # [L, P, page_size, Hkv, head_dim]
+    k_pages: Any  # [L, P, Hkv, page_size, head_dim]
+    v_pages: Any  # [L, P, Hkv, page_size, head_dim]
     page_size: int
     num_pages: int
 
     @classmethod
     def create(cls, config: LlamaConfig, num_pages: int, page_size: int) -> "PagedKVCache":
-        shape = (config.n_layers, num_pages, page_size, config.n_kv_heads, config.head_dim)
+        shape = (config.n_layers, num_pages, config.n_kv_heads, page_size, config.head_dim)
         return cls(
             k_pages=jnp.zeros(shape, config.dtype),
             v_pages=jnp.zeros(shape, config.dtype),
@@ -130,7 +133,7 @@ def pages_needed(n_tokens: int, page_size: int) -> int:
 
 
 def scatter_kv_chunk(
-    k_pages_layer: Any,  # [P, page_size, Hkv, hd] one layer's pages
+    k_pages_layer: Any,  # [P, Hkv, page_size, hd] one layer's pages
     v_pages_layer: Any,
     k_new: Any,  # [B, C, Hkv, hd]
     v_new: Any,
@@ -155,17 +158,18 @@ def scatter_kv_chunk(
     valid = i < n_valid[:, None]
     phys = jnp.where(valid, phys, TRASH_PAGE)
 
-    flat_phys = phys.reshape(-1)
+    flat_phys = phys.reshape(-1)  # [B*C]
     flat_off = offset.reshape(-1)
-    k_flat = k_new.reshape(B * C, *k_new.shape[2:])
+    # token (page, head, offset) destination; heads ride along as a slice
+    k_flat = k_new.reshape(B * C, *k_new.shape[2:])  # [B*C, Hkv, hd]
     v_flat = v_new.reshape(B * C, *v_new.shape[2:])
-    k_pages_layer = k_pages_layer.at[flat_phys, flat_off].set(k_flat, mode="drop")
-    v_pages_layer = v_pages_layer.at[flat_phys, flat_off].set(v_flat, mode="drop")
+    k_pages_layer = k_pages_layer.at[flat_phys, :, flat_off].set(k_flat, mode="drop")
+    v_pages_layer = v_pages_layer.at[flat_phys, :, flat_off].set(v_flat, mode="drop")
     return k_pages_layer, v_pages_layer
 
 
 def gather_kv(
-    k_pages_layer: Any,  # [P, page_size, Hkv, hd]
+    k_pages_layer: Any,  # [P, Hkv, page_size, hd]
     v_pages_layer: Any,
     page_table: Any,  # [B, max_pages]
     page_size: int,
@@ -174,8 +178,8 @@ def gather_kv(
     view (max_len = max_pages * page_size). Reference path; the Pallas paged
     kernel reads pages in place instead."""
     B, max_pages = page_table.shape
-    k = k_pages_layer[page_table]  # [B, max_pages, page_size, Hkv, hd]
+    k = k_pages_layer[page_table]  # [B, max_pages, Hkv, page_size, hd]
     v = v_pages_layer[page_table]
-    k = k.reshape(B, max_pages * page_size, *k.shape[3:])
-    v = v.reshape(B, max_pages * page_size, *v.shape[3:])
+    k = k.transpose(0, 1, 3, 2, 4).reshape(B, max_pages * page_size, k.shape[2], k.shape[4])
+    v = v.transpose(0, 1, 3, 2, 4).reshape(B, max_pages * page_size, v.shape[2], v.shape[4])
     return k, v

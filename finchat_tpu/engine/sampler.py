@@ -22,6 +22,9 @@ class SamplingParams:
     top_k: int = 0  # 0 = disabled
     max_new_tokens: int = 1024
     seed: int = 0
+    # named output grammar ("tool_call") for constrained decoding
+    # (agent/constrained.py); None = unconstrained
+    grammar: str | None = None
 
 
 def sample(

@@ -24,10 +24,15 @@ import time
 from collections import deque
 from dataclasses import dataclass, field
 
+from typing import TYPE_CHECKING
+
 import jax.numpy as jnp
 import numpy as np
 
 from finchat_tpu.engine.engine import InferenceEngine, commit_first_token, prefill_step
+
+if TYPE_CHECKING:  # engine must not import the agent layer at runtime
+    from finchat_tpu.agent.constrained import TokenConstraint
 from finchat_tpu.engine.kv_cache import PageAllocator, pages_needed
 from finchat_tpu.engine.sampler import SamplingParams
 from finchat_tpu.utils.logging import get_logger
@@ -45,6 +50,7 @@ class SequenceHandle:
     seq_id: str
     prompt_ids: list[int]
     sampling: SamplingParams
+    constraint: TokenConstraint | None = None
     events: asyncio.Queue = field(default_factory=asyncio.Queue)
     slot: int = -1
     prefill_pos: int = 0  # prompt tokens already prefilled
@@ -76,6 +82,7 @@ class ContinuousBatchingScheduler:
         self._wakeup = asyncio.Event()
         self._task: asyncio.Task | None = None
         self._running = False
+        self._rng = np.random.default_rng(0)  # host-side constrained sampling
 
     # --- public API -----------------------------------------------------
     async def start(self) -> None:
@@ -88,7 +95,13 @@ class ContinuousBatchingScheduler:
         if self._task:
             await self._task
 
-    async def submit(self, seq_id: str, prompt_ids: list[int], sampling: SamplingParams) -> SequenceHandle:
+    async def submit(
+        self,
+        seq_id: str,
+        prompt_ids: list[int],
+        sampling: SamplingParams,
+        constraint: TokenConstraint | None = None,
+    ) -> SequenceHandle:
         if not prompt_ids:
             raise ValueError("empty prompt")
         max_len = self.engine.max_pages_per_seq * self.engine.page_size
@@ -97,7 +110,9 @@ class ContinuousBatchingScheduler:
                 f"sequence {seq_id}: prompt {len(prompt_ids)} + max_new "
                 f"{sampling.max_new_tokens} exceeds max length {max_len}"
             )
-        handle = SequenceHandle(seq_id=seq_id, prompt_ids=list(prompt_ids), sampling=sampling)
+        handle = SequenceHandle(
+            seq_id=seq_id, prompt_ids=list(prompt_ids), sampling=sampling, constraint=constraint
+        )
         self.pending.append(handle)
         METRICS.set_gauge("finchat_queue_depth", len(self.pending))
         self._wakeup.set()
@@ -168,6 +183,7 @@ class ContinuousBatchingScheduler:
             eng.params, eng.state, tokens,
             jnp.int32(handle.slot), jnp.int32(handle.prefill_pos), jnp.int32(n_valid),
             config=eng.config, page_size=eng.page_size,
+            attn_backend=eng.attn_backend,
         )
         handle.prefill_pos += n_valid
         if handle.prefill_pos >= len(handle.prompt_ids):
@@ -176,6 +192,13 @@ class ContinuousBatchingScheduler:
                 eng.state, jnp.int32(handle.slot), last_logits,
                 jnp.float32(s.temperature), jnp.float32(s.top_p), jnp.int32(s.top_k),
             )
+            if handle.constraint is not None:
+                token = handle.constraint.pick(
+                    np.asarray(last_logits), s.temperature, self._rng,
+                    remaining=s.max_new_tokens - handle.generated,
+                    top_p=s.top_p, top_k=s.top_k,
+                )
+                eng.set_last_token(handle.slot, token)
             self.prefilling.remove(handle)
             self.decoding[handle.slot] = handle
             self._deliver(handle, int(token))
@@ -198,15 +221,30 @@ class ContinuousBatchingScheduler:
         active = np.zeros((B,), bool)
         for slot in self.decoding:
             active[slot] = True
-        next_tokens = eng.decode(
+        # step logits come back to host only while a grammar-constrained
+        # sequence is in flight (a second compiled decode variant)
+        need_logits = any(h.constraint is not None for h in self.decoding.values())
+        result = eng.decode(
             jnp.asarray(active),
             jnp.asarray(self._temperature),
             jnp.asarray(self._top_p),
             jnp.asarray(self._top_k),
+            return_logits=need_logits,
         )
+        next_tokens, logits = result if need_logits else (result, None)
         tokens_host = np.asarray(next_tokens)
+        logits_host = np.asarray(logits) if logits is not None else None
         for slot, handle in list(self.decoding.items()):
-            self._deliver(handle, int(tokens_host[slot]))
+            if handle.constraint is not None and logits_host is not None:
+                token = handle.constraint.pick(
+                    logits_host[slot], handle.sampling.temperature, self._rng,
+                    remaining=handle.sampling.max_new_tokens - handle.generated,
+                    top_p=handle.sampling.top_p, top_k=handle.sampling.top_k,
+                )
+                eng.set_last_token(slot, token)
+                self._deliver(handle, token)
+            else:
+                self._deliver(handle, int(tokens_host[slot]))
         METRICS.set_gauge("finchat_batch_occupancy", len(self.decoding))
 
     async def _loop(self) -> None:

@@ -198,19 +198,47 @@ def forward(
     return logits, new_cache
 
 
-def full_causal_attention(q: Array, k: Array, v: Array, layer_cache: Any, layer_idx: Array) -> tuple[Array, Any]:
+def make_causal_attention(backend: str) -> AttentionFn:
     """Cache-less causal attention over the whole sequence (training, tests,
-    one-shot prefill). Uses the jnp reference; the Pallas flash kernel slots
-    in via ops.flash_attention."""
-    from finchat_tpu.ops.refs import mha_reference
+    one-shot prefill) on an explicitly-resolved backend. Callers that jit
+    must resolve the backend OUTSIDE the traced function and key their jit
+    cache on it — resolving env state at trace time bakes the first answer
+    into the cache (see ops/dispatch.py)."""
+    from finchat_tpu.ops.dispatch import causal_attention
 
-    return mha_reference(q, k, v, causal=True), layer_cache
+    def attention(q: Array, k: Array, v: Array, layer_cache: Any, layer_idx: Array) -> tuple[Array, Any]:
+        return causal_attention(q, k, v, backend=backend), layer_cache
+
+    return attention
 
 
-@partial(jax.jit, static_argnames=("config",))
-def forward_full(params: dict[str, Any], tokens: Array, positions: Array, *, config: LlamaConfig) -> Array:
-    """Convenience jitted forward with full causal attention, no cache."""
+def full_causal_attention(q: Array, k: Array, v: Array, layer_cache: Any, layer_idx: Array) -> tuple[Array, Any]:
+    """Backend resolved per-call — ONLY for non-jitted use or single-trace
+    contexts; jitted callers should use make_causal_attention(backend)."""
+    from finchat_tpu.ops.dispatch import causal_attention
+
+    return causal_attention(q, k, v), layer_cache
+
+
+@partial(jax.jit, static_argnames=("config", "attn_backend"))
+def _forward_full_jit(
+    params: dict[str, Any], tokens: Array, positions: Array, *, config: LlamaConfig, attn_backend: str
+) -> Array:
     logits, _ = forward(
-        params, tokens, positions, config=config, attention=full_causal_attention, cache=None
+        params, tokens, positions, config=config,
+        attention=make_causal_attention(attn_backend), cache=None,
     )
     return logits
+
+
+def forward_full(
+    params: dict[str, Any], tokens: Array, positions: Array, *,
+    config: LlamaConfig, attn_backend: str | None = None,
+) -> Array:
+    """Convenience jitted forward with full causal attention, no cache.
+    The backend resolves at CALL time and keys the jit cache."""
+    if attn_backend is None:
+        from finchat_tpu.ops.dispatch import attention_backend
+
+        attn_backend = attention_backend()
+    return _forward_full_jit(params, tokens, positions, config=config, attn_backend=attn_backend)

@@ -1,3 +1,14 @@
-from finchat_tpu.ops.refs import mha_reference, gqa_repeat
+from finchat_tpu.ops.dispatch import attention_backend, causal_attention, paged_attention
+from finchat_tpu.ops.flash_attention import flash_attention
+from finchat_tpu.ops.paged_attention import paged_flash_attention
+from finchat_tpu.ops.refs import gqa_repeat, mha_reference
 
-__all__ = ["mha_reference", "gqa_repeat"]
+__all__ = [
+    "attention_backend",
+    "causal_attention",
+    "flash_attention",
+    "gqa_repeat",
+    "mha_reference",
+    "paged_attention",
+    "paged_flash_attention",
+]

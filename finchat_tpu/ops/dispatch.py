@@ -1,0 +1,80 @@
+"""Attention backend dispatch: Pallas kernels on TPU, jnp references on CPU.
+
+One switch for the whole engine (SURVEY §7.2 step 4 wiring). Resolution
+order:
+
+1. ``FINCHAT_ATTN`` env var: ``pallas`` | ``ref`` | ``pallas-interpret``
+   (the last runs the Pallas kernels through the interpreter on any backend
+   — what the CI mesh uses to exercise kernel code paths without a TPU);
+2. default: ``pallas`` when the runtime backend is TPU, else ``ref``.
+
+The reference implementations are the correctness oracles and stay the
+fallback everywhere Mosaic can't lower (CPU test meshes, odd head_dims).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+from jax import Array
+
+from finchat_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_VALID = ("pallas", "ref", "pallas-interpret")
+
+
+def attention_backend() -> str:
+    """Resolve the default backend. Callers that jit should resolve ONCE and
+    pass the result through as a static argument (the engine does) — reading
+    env inside a traced function would bake the first resolution into the
+    jit cache."""
+    choice = os.getenv("FINCHAT_ATTN", "").strip().lower()
+    if choice:
+        if choice not in _VALID:
+            raise ValueError(f"FINCHAT_ATTN must be one of {_VALID}, got {choice!r}")
+        return choice
+    return "pallas" if jax.default_backend() == "tpu" else "ref"
+
+
+def paged_attention(
+    q: Array,  # [B, C, H, D]
+    k_pages: Array,  # [P, Hkv, page_size, D]
+    v_pages: Array,
+    page_table: Array,  # [B, max_pages]
+    q_offset: Array,  # [B]
+    kv_len: Array,  # [B]
+    *,
+    page_size: int,
+    backend: str | None = None,
+) -> Array:
+    """Paged-KV attention via the requested (or default) backend."""
+    backend = backend or attention_backend()
+    if backend == "ref":
+        from finchat_tpu.engine.kv_cache import gather_kv
+        from finchat_tpu.ops.refs import mha_reference
+
+        k_all, v_all = gather_kv(k_pages, v_pages, page_table, page_size)
+        return mha_reference(
+            q, k_all, v_all, causal=True, q_offset=q_offset, kv_len=kv_len
+        )
+    from finchat_tpu.ops.paged_attention import paged_flash_attention
+
+    return paged_flash_attention(
+        q, k_pages, v_pages, page_table, q_offset, kv_len,
+        page_size=page_size, interpret=(backend == "pallas-interpret"),
+    )
+
+
+def causal_attention(q: Array, k: Array, v: Array, *, backend: str | None = None) -> Array:
+    """Full contiguous causal attention (training / one-shot prefill)."""
+    backend = backend or attention_backend()
+    if backend == "ref":
+        from finchat_tpu.ops.refs import mha_reference
+
+        return mha_reference(q, k, v, causal=True)
+    from finchat_tpu.ops.flash_attention import flash_attention
+
+    return flash_attention(q, k, v, causal=True, interpret=(backend == "pallas-interpret"))

@@ -1,0 +1,195 @@
+"""Pallas ragged paged attention for TPU — the decode-side hot kernel.
+
+SURVEY §7.3 hard part #1: this kernel gates the decode-throughput target.
+The jnp reference path (engine/kv_cache.py ``gather_kv`` + ``mha_reference``)
+materializes every sequence's pages into a dense ``[B, max_pages*page_size]``
+KV copy per layer per step — reading AND writing the whole allocation-shaped
+cache through HBM each token. This kernel instead reads K/V pages **in
+place** via a scalar-prefetched page table, so per-step HBM traffic is
+exactly the live KV bytes (ragged per sequence), with Pallas double-buffering
+the page DMAs behind the MXU work.
+
+Design:
+- grid ``(B, Hkv, nq, max_pages)`` — page axis innermost; online-softmax
+  state (m, l, acc) carries across a sequence's pages in VMEM scratch.
+- the K/V BlockSpec index map resolves ``page_table[b, p]`` at DMA time
+  (PrefetchScalarGridSpec); pages that are causally skippable or past
+  ``kv_len[b]`` are redirected to the trash page (physical page 0, the same
+  page the cache scatter parks padding writes in — engine/kv_cache.py), and
+  consecutive identical block indices are not re-fetched by the pipeline.
+- pages are head-major ``[P, Hkv, page_size, head_dim]`` so one (page,
+  kv-head) DMA is a contiguous Mosaic-tileable (page_size, head_dim) tile.
+- GQA: one program per KV head; its ``group = H // Hkv`` query heads ride in
+  the same block, so each page's K/V slice is fetched once total.
+
+Serves both decode (C = 1) and paged chunked prefill (C = chunk) — the same
+causal/ragged masking as ``ops.refs.mha_reference`` with ``q_offset``/
+``kv_len`` semantics.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from finchat_tpu.ops.flash_attention import (
+    NEG_INF,
+    _online_softmax_update,
+    _pick_block,
+    _round_up,
+)
+
+TRASH_PAGE = 0
+
+
+def _paged_kernel(
+    # scalar prefetch
+    page_table_ref,  # [B, max_pages] int32 in SMEM
+    q_offset_ref,  # [B] int32
+    kv_len_ref,  # [B] int32
+    # blocks (head-major)
+    q_ref,  # [1, G, Bq, D]
+    k_ref,  # [1, 1, page_size, D] — one physical page, one KV head
+    v_ref,
+    o_ref,  # [1, G, Bq, D]
+    # scratch
+    m_scr,  # [Rpad, 128] fp32
+    l_scr,
+    acc_scr,  # [Rpad, D] fp32
+    *,
+    block_q: int,
+    page_size: int,
+    group: int,
+    scale: float,
+):
+    b = pl.program_id(0)
+    qi = pl.program_id(2)
+    p = pl.program_id(3)
+    n_pages = pl.num_programs(3)
+
+    Bq = block_q
+    R = group * Bq
+    q_off = q_offset_ref[b]
+    kv_len = kv_len_ref[b]
+
+    @pl.when(p == 0)
+    def _init():
+        m_scr[:] = jnp.full(m_scr.shape, NEG_INF, jnp.float32)
+        l_scr[:] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    page_start = p * page_size
+    q_max = q_off + (qi + 1) * Bq - 1
+    needed = jnp.logical_and(page_start < kv_len, page_start <= q_max)
+
+    @pl.when(needed)
+    def _accumulate():
+        q_blk = q_ref[0].reshape(R, q_ref.shape[3])  # row r = head r//Bq, pos r%Bq
+        k_blk = k_ref[0, 0]  # [page_size, D]
+        v_blk = v_ref[0, 0]
+
+        rows = jax.lax.broadcasted_iota(jnp.int32, (R, page_size), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (R, page_size), 1)
+        q_pos = q_off + qi * Bq + rows % Bq
+        kv_pos = page_start + cols
+        invalid = jnp.logical_or(kv_pos >= kv_len, kv_pos > q_pos)
+
+        m_new, l_new, acc_new = _online_softmax_update(
+            q_blk, k_blk, v_blk, invalid,
+            m_scr[:R, :1], l_scr[:R, :1], acc_scr[:R], scale,
+        )
+        m_scr[:R, :1] = m_new
+        l_scr[:R, :1] = l_new
+        acc_scr[:R] = acc_new
+
+    @pl.when(p == n_pages - 1)
+    def _finalize():
+        out = acc_scr[:R] / jnp.maximum(l_scr[:R, :1], 1e-30)
+        o_ref[0] = out.reshape(group, Bq, -1).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("page_size", "scale", "block_q", "interpret"),
+)
+def paged_flash_attention(
+    q: Array,  # [B, C, H, D] — C = 1 for decode, chunk size for prefill
+    k_pages: Array,  # [P, Hkv, page_size, D] — one layer's pages, in place
+    v_pages: Array,
+    page_table: Array,  # [B, max_pages] int32 physical page ids (0 = trash)
+    q_offset: Array,  # [B] int32 — absolute position of q[:, 0]
+    kv_len: Array,  # [B] int32 — valid KV length incl. this chunk's tokens
+    *,
+    page_size: int,
+    scale: float | None = None,
+    block_q: int = 128,
+    interpret: bool | None = None,
+) -> Array:
+    """Attention over the paged KV cache; returns [B, C, H, D].
+
+    Causal with absolute positions (query row i of batch b is at
+    ``q_offset[b] + i``); sequences with ``kv_len == 0`` produce zeros.
+    The current chunk's K/V must already be scattered into the pages
+    (engine/kv_cache.py ``scatter_kv_chunk`` runs first).
+    """
+    B, C, H, D = q.shape
+    Hkv = k_pages.shape[1]
+    max_pages = page_table.shape[1]
+    assert H % Hkv == 0, (H, Hkv)
+    assert k_pages.shape[2] == page_size, (k_pages.shape, page_size)
+    group = H // Hkv
+    scale = scale if scale is not None else D ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    q_offset = jnp.asarray(q_offset, jnp.int32)
+    kv_len = jnp.asarray(kv_len, jnp.int32)
+    page_table = jnp.asarray(page_table, jnp.int32)
+
+    bq = _pick_block(C, block_q)
+    nq = C // bq
+    r_pad = _round_up(max(group * bq, 8), 8)
+
+    q_t = q.transpose(0, 2, 1, 3)  # [B, H, C, D]
+
+    def kv_index(b, h, qi, p, page_table_ref, q_offset_ref, kv_len_ref):
+        # resolve logical page -> physical page at DMA time; redirect pages
+        # that contribute nothing to the trash page (repeat fetches of the
+        # same block index are skipped by the pipeline)
+        page_start = p * page_size
+        q_max = q_offset_ref[b] + (qi + 1) * bq - 1
+        needed = jnp.logical_and(page_start < kv_len_ref[b], page_start <= q_max)
+        phys = jnp.where(needed, page_table_ref[b, p], TRASH_PAGE)
+        return (phys, h, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B, Hkv, nq, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, group, bq, D), lambda b, h, qi, p, *_: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, page_size, D), kv_index),
+            pl.BlockSpec((1, 1, page_size, D), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, group, bq, D), lambda b, h, qi, p, *_: (b, h, qi, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((r_pad, 128), jnp.float32),
+            pltpu.VMEM((r_pad, 128), jnp.float32),
+            pltpu.VMEM((r_pad, D), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _paged_kernel,
+        block_q=bq, page_size=page_size, group=group, scale=scale,
+    )
+    out_t = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, C, D), q.dtype),
+        interpret=interpret,
+    )(page_table, q_offset, kv_len, q_t, k_pages, v_pages)
+    return out_t.transpose(0, 2, 1, 3)

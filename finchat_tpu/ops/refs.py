@@ -57,8 +57,10 @@ def mha_reference(
     kv_pos = jnp.arange(Sk)[None, None, None, :]  # [1,1,1,Sk]
     mask = jnp.zeros((B, 1, Sq, Sk), dtype=bool)
     if causal:
-        q_pos = q_offset + jnp.arange(Sq)
-        q_pos = jnp.broadcast_to(q_pos, (B, Sq)) if jnp.ndim(q_offset) == 0 else q_offset[:, None] + jnp.arange(Sq)[None, :]
+        if jnp.ndim(q_offset) == 0:
+            q_pos = jnp.broadcast_to(q_offset + jnp.arange(Sq), (B, Sq))
+        else:
+            q_pos = q_offset[:, None] + jnp.arange(Sq)[None, :]
         mask = mask | (kv_pos > q_pos[:, None, :, None])
     if kv_len is not None:
         mask = mask | (kv_pos >= kv_len[:, None, None, None])

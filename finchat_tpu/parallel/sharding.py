@@ -65,9 +65,9 @@ def decode_state_shardings(mesh: Mesh) -> dict[str, Any]:
         return NamedSharding(mesh, P(*spec))
 
     return {
-        # [L, pages, page_size, Hkv, hd] — KV heads on the model axis
-        "k_pages": ns(None, None, None, "model", None),
-        "v_pages": ns(None, None, None, "model", None),
+        # [L, pages, Hkv, page_size, hd] — KV heads on the model axis
+        "k_pages": ns(None, None, "model", None, None),
+        "v_pages": ns(None, None, "model", None, None),
         "page_table": ns(None, None),
         "context_lens": ns(None),
         "last_tokens": ns(None),

@@ -23,7 +23,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from finchat_tpu.models.llama import LlamaConfig, forward, full_causal_attention
+from finchat_tpu.models.llama import LlamaConfig, forward, make_causal_attention
 from finchat_tpu.ops.ring_attention import ring_attention
 from finchat_tpu.utils.logging import get_logger
 
@@ -73,7 +73,11 @@ def make_train_step(
         assert mesh is not None, "ring attention needs a mesh"
         attention = _ring_attention_callback(mesh)
     else:
-        attention = full_causal_attention
+        # resolve the backend NOW (build time), not at trace time — the jit
+        # cache below is not keyed on env state (see ops/dispatch.py)
+        from finchat_tpu.ops.dispatch import attention_backend
+
+        attention = make_causal_attention(attention_backend())
 
     def loss_fn(params, tokens):
         B, S = tokens.shape

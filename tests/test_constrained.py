@@ -1,0 +1,240 @@
+"""Grammar-constrained tool-decision decoding (agent/constrained.py).
+
+The few-shot call formats in prompts/tool_prompt.txt are acceptance cases
+(SURVEY §7.3 hard part #5: they become test cases), and an end-to-end run
+through the scheduler must ALWAYS yield parsable output even from a
+random-weight model — the whole point of constraining.
+"""
+
+import asyncio
+
+import jax
+import numpy as np
+import pytest
+
+from finchat_tpu.agent.constrained import (
+    DEAD,
+    GrammarVocab,
+    TokenConstraint,
+    build_tool_grammar,
+)
+from finchat_tpu.agent.toolcall import parse_tool_decision
+from finchat_tpu.models.tokenizer import ByteTokenizer
+
+
+@pytest.fixture(scope="module")
+def dfa():
+    return build_tool_grammar()
+
+
+def accepts(dfa, text: str) -> bool:
+    state = dfa.step_string(dfa.start, text)
+    return state != DEAD and dfa.eos_ok[state]
+
+
+def is_live_prefix(dfa, text: str) -> bool:
+    return dfa.step_string(dfa.start, text) != DEAD
+
+
+@pytest.mark.parametrize(
+    "text",
+    [
+        "No tool call",
+        'retrieve_transactions({"search_query": "grocery store purchases", "num_transactions": 20})',
+        'retrieve_transactions({"search_query": "all purchases", "time_period_days": 2})',
+        "retrieve_transactions({})",
+        'retrieve_transactions({"num_transactions": 100})',
+        'retrieve_transactions({ "search_query" : "coffee" , "num_transactions" : 5 })',
+        '  No tool call',  # leading whitespace tolerated
+    ],
+)
+def test_grammar_accepts_valid_outputs(dfa, text):
+    assert accepts(dfa, text)
+
+
+@pytest.mark.parametrize(
+    "text",
+    [
+        "Hello! I'm here to help",  # prose
+        "no tool call",  # wrong case is not the literal contract
+        "retrieve_transactions(",  # incomplete: not accepting (but live)
+        'retrieve_transactions({"user_id": "u1"})',  # user_id is NOT grammatical
+        'retrieve_transactions({"search_query": 5})',  # wrong value type
+        'retrieve_transactions({"num_transactions": "many"})',
+        "retrieve_transactions({}) extra",  # trailing junk
+        'create_financial_plot({})',  # unknown tool
+    ],
+)
+def test_grammar_rejects_invalid_outputs(dfa, text):
+    assert not accepts(dfa, text)
+
+
+def test_incomplete_prefixes_stay_live(dfa):
+    for prefix in ["No to", "retrieve_trans", 'retrieve_transactions({"sea', 'retrieve_transactions({"num_transactions": 1']:
+        assert is_live_prefix(dfa, prefix)
+
+
+def test_every_accepted_output_parses():
+    """Grammar ⊆ parser: anything the DFA accepts must produce a well-formed
+    decision in toolcall.parse_tool_decision."""
+    samples = [
+        "No tool call",
+        'retrieve_transactions({"search_query": "rent payments", "num_transactions": 3})',
+        'retrieve_transactions({"time_period_days": 30})',
+        "retrieve_transactions({})",
+    ]
+    dfa = build_tool_grammar()
+    for text in samples:
+        assert accepts(dfa, text)
+        if text == "No tool call":
+            assert parse_tool_decision(text) is None
+        else:
+            call = parse_tool_decision(text)
+            assert call is not None and call.name == "retrieve_transactions"
+            assert "user_id" not in call.args
+
+
+def test_start_mask_byte_vocab():
+    tok = ByteTokenizer()
+    vocab = GrammarVocab.for_tokenizer(tok)
+    allowed, eos_ok = vocab.mask(vocab.dfa.start)
+    assert not eos_ok  # empty output is not grammatical
+    assert allowed[ord("N")] and allowed[ord("r")] and allowed[ord(" ")]
+    assert not allowed[ord("H")] and not allowed[ord("{")]
+    # specials carry no text and are never allowed
+    assert not allowed[tok.pad_id] and not allowed[tok.bos_id]
+
+
+def test_constrained_pick_greedy_forces_grammar():
+    """Even with adversarial logits (all mass on junk), picks stay in-grammar
+    and terminate; the result always parses."""
+    tok = ByteTokenizer()
+    vocab = GrammarVocab.for_tokenizer(tok)
+    c = TokenConstraint(vocab)
+    rng = np.random.default_rng(0)
+    logits = np.zeros((tok.vocab_size,), np.float32)
+    logits[ord("H")] = 100.0  # the model "wants" to say Hello
+    out = []
+    for _ in range(128):
+        t = c.pick(logits, 0.0, rng)
+        if t == tok.eos_id:
+            break
+        out.append(t)
+    text = tok.decode(out)
+    dfa = build_tool_grammar()
+    assert accepts(dfa, text), text
+
+
+def test_constrained_sampling_terminates_and_parses():
+    """Stochastic picks (temperature 1) across many seeds: always grammatical."""
+    tok = ByteTokenizer()
+    vocab = GrammarVocab.for_tokenizer(tok)
+    dfa = vocab.dfa
+    budget = 96  # tool_sampling's max_new_tokens: closing mode must land it
+    for seed in range(5):
+        rng = np.random.default_rng(seed)
+        c = TokenConstraint(vocab)
+        logits = np.asarray(rng.normal(size=(tok.vocab_size,)) * 3, np.float32)
+        out = []
+        for step in range(budget):
+            t = c.pick(logits, 1.0, rng, remaining=budget - step)
+            if t == tok.eos_id:
+                break
+            out.append(t)
+        else:
+            pytest.fail("did not terminate within budget")
+        text = tok.decode(out)
+        assert accepts(dfa, text), text
+        parse_tool_decision(text)  # must not raise
+
+
+async def _run_constrained_engine():
+    from finchat_tpu.engine.engine import InferenceEngine
+    from finchat_tpu.engine.generator import EngineGenerator
+    from finchat_tpu.engine.sampler import SamplingParams
+    from finchat_tpu.engine.scheduler import ContinuousBatchingScheduler
+    from finchat_tpu.models.llama import PRESETS, init_params
+    from finchat_tpu.utils.config import EngineConfig
+
+    tok = ByteTokenizer()
+    config = PRESETS["tiny"]
+    engine_cfg = EngineConfig(
+        max_seqs=2, page_size=8, num_pages=64, max_seq_len=256, prefill_chunk=16
+    )
+    params = init_params(config, jax.random.key(0))
+    engine = InferenceEngine(config, params, engine_cfg)
+    scheduler = ContinuousBatchingScheduler(engine, eos_id=tok.eos_id)
+    gen = EngineGenerator(scheduler, tok)
+    await scheduler.start()
+    try:
+        text = await gen.generate(
+            "User: What did I spend on coffee?",
+            SamplingParams(temperature=0.7, max_new_tokens=96, grammar="tool_call"),
+        )
+    finally:
+        await scheduler.stop()
+    return text
+
+
+def test_engine_constrained_generation_end_to_end():
+    """A RANDOM-weight model through the real scheduler produces grammatical,
+    parsable tool decisions — structure comes from the constraint alone."""
+    text = asyncio.run(_run_constrained_engine())
+    dfa = build_tool_grammar()
+    state = dfa.step_string(dfa.start, text)
+    # either completed (accepting) or hit the token budget mid-grammar (live)
+    assert state != DEAD, text
+    parse_tool_decision(text)  # never raises
+
+
+def test_token_texts_sentencepiece_style():
+    """decode([i]) strips the SentencePiece leading-space marker; token_texts
+    must recover the real emitted text ('▁No' -> ' No') or the DFA diverges
+    from the stream."""
+    from finchat_tpu.agent.constrained import token_texts
+
+    class FakeSPInner:
+        all_special_ids = [0]
+
+        def convert_ids_to_tokens(self, ids):
+            table = {0: "<s>", 1: "▁No", 2: "▁tool", 3: "call", 4: "<0x7B>", 5: "to"}
+            return [table[i] for i in ids]
+
+    class FakeSPTokenizer:
+        vocab_size = 6
+        eos_id = 0
+        _tok = FakeSPInner()
+
+        def decode(self, ids):
+            # single-token decode strips the marker — the trap
+            return "".join(
+                {0: "", 1: "No", 2: "tool", 3: "call", 4: "{", 5: "to"}[i] for i in ids
+            )
+
+    texts = token_texts(FakeSPTokenizer())
+    assert texts == ["", " No", " tool", "call", "{", "to"]
+
+
+def test_grammar_vocab_multitoken_literal_with_sp_texts():
+    """With correct per-token texts, a multi-token path through the literal
+    'No tool call' stays live and lands accepting."""
+    from finchat_tpu.agent.constrained import GrammarVocab, build_tool_grammar
+
+    vocab = GrammarVocab(build_tool_grammar(), ["", "No", " tool", " call", "xx"], eos_id=0)
+    allowed, _ = vocab.mask(vocab.dfa.start)
+    assert allowed[1] and not allowed[4] and not allowed[0]
+    s = vocab.advance(vocab.dfa.start, 1)  # "No"
+    allowed, _ = vocab.mask(s)
+    assert allowed[2]  # " tool"
+    s = vocab.advance(s, 2)
+    s = vocab.advance(s, 3)  # " call"
+    assert vocab.dfa.eos_ok[s]
+
+
+def test_string_values_exclude_parser_breaking_chars():
+    """Grammar ⊆ parser: '}' and ')' cannot appear inside string values
+    (they would truncate toolcall.py's non-greedy extraction regex)."""
+    dfa = build_tool_grammar()
+    bad = 'retrieve_transactions({"search_query": "food} 2024"})'
+    prefix = bad[: bad.index("}") + 1]  # up to and including the in-string '}'
+    assert not is_live_prefix(dfa, prefix)

@@ -62,8 +62,8 @@ def test_free_then_realloc_keeps_invariants():
 
 def test_scatter_gather_roundtrip():
     P, ps, Hkv, hd = 6, 4, 2, 8
-    k_pages = jnp.zeros((P, ps, Hkv, hd))
-    v_pages = jnp.zeros((P, ps, Hkv, hd))
+    k_pages = jnp.zeros((P, Hkv, ps, hd))
+    v_pages = jnp.zeros((P, Hkv, ps, hd))
     B, C = 1, 6
     k_new = jnp.arange(B * C * Hkv * hd, dtype=jnp.float32).reshape(B, C, Hkv, hd)
     v_new = -k_new
@@ -85,8 +85,8 @@ def test_scatter_gather_roundtrip():
 
 def test_scatter_padding_goes_to_trash():
     P, ps, Hkv, hd = 4, 4, 1, 2
-    k_pages = jnp.zeros((P, ps, Hkv, hd))
-    v_pages = jnp.zeros((P, ps, Hkv, hd))
+    k_pages = jnp.zeros((P, Hkv, ps, hd))
+    v_pages = jnp.zeros((P, Hkv, ps, hd))
     k_new = jnp.ones((1, 4, Hkv, hd))
     page_table = jnp.asarray([[1, 2]], jnp.int32)
     k_pages, v_pages = scatter_kv_chunk(
@@ -94,6 +94,6 @@ def test_scatter_padding_goes_to_trash():
         start_pos=jnp.asarray([0]), n_valid=jnp.asarray([2]), page_size=ps,
     )
     # only 2 valid tokens written to page 1; padding went to trash page 0
-    assert float(k_pages[1, :2].sum()) == 2 * Hkv * hd
-    assert float(k_pages[1, 2:].sum()) == 0.0
+    assert float(k_pages[1, :, :2].sum()) == 2 * Hkv * hd
+    assert float(k_pages[1, :, 2:].sum()) == 0.0
     assert float(k_pages[2].sum()) == 0.0

@@ -1,0 +1,222 @@
+"""Pallas kernels vs jnp reference oracles (SURVEY §4.2).
+
+Runs in interpret mode on the CPU test mesh; the same code paths compile
+with Mosaic on TPU (bench.py exercises that).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from finchat_tpu.engine.kv_cache import gather_kv, scatter_kv_chunk
+from finchat_tpu.ops.flash_attention import flash_attention
+from finchat_tpu.ops.paged_attention import paged_flash_attention
+from finchat_tpu.ops.refs import mha_reference
+
+
+def _rand_qkv(key, B, Sq, Sk, H, Hkv, D, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, Sq, H, D), dtype)
+    k = jax.random.normal(kk, (B, Sk, Hkv, D), dtype)
+    v = jax.random.normal(kv, (B, Sk, Hkv, D), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize(
+    "B,Sq,Sk,H,Hkv,D",
+    [
+        (1, 128, 128, 4, 4, 64),  # MHA, square
+        (2, 64, 256, 8, 2, 64),  # GQA, kv longer than q
+        (1, 256, 512, 4, 1, 128),  # MQA
+    ],
+)
+def test_flash_matches_reference_causal(B, Sq, Sk, H, Hkv, D):
+    q, k, v = _rand_qkv(jax.random.key(0), B, Sq, Sk, H, Hkv, D)
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_flash_q_offset_and_kv_len():
+    """Chunked-prefill semantics: q chunk sits at an offset inside a padded
+    KV axis whose valid length differs per batch element."""
+    B, Sq, Sk, H, Hkv, D = 2, 64, 256, 4, 2, 64
+    q, k, v = _rand_qkv(jax.random.key(1), B, Sq, Sk, H, Hkv, D)
+    q_offset = jnp.array([32, 100], jnp.int32)
+    kv_len = jnp.array([96, 164], jnp.int32)  # q_offset + Sq
+    out = flash_attention(q, k, v, q_offset=q_offset, kv_len=kv_len, interpret=True)
+    ref = mha_reference(q, k, v, causal=True, q_offset=q_offset, kv_len=kv_len)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_flash_non_causal():
+    B, Sq, Sk, H, Hkv, D = 1, 128, 128, 4, 4, 64
+    q, k, v = _rand_qkv(jax.random.key(2), B, Sq, Sk, H, Hkv, D)
+    out = flash_attention(q, k, v, causal=False, interpret=True)
+    ref = mha_reference(q, k, v, causal=False)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_flash_bf16_tolerance():
+    B, Sq, Sk, H, Hkv, D = 1, 128, 128, 8, 4, 64
+    q, k, v = _rand_qkv(jax.random.key(3), B, Sq, Sk, H, Hkv, D, jnp.bfloat16)
+    out = flash_attention(q, k, v, interpret=True)
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        out.astype(jnp.float32), ref.astype(jnp.float32), atol=2e-2, rtol=2e-2
+    )
+
+
+# ---------------------------------------------------------------------------
+# paged decode/prefill kernel
+# ---------------------------------------------------------------------------
+
+
+def _build_paged_case(key, B, H, Hkv, D, page_size, max_pages, ctx_lens, C):
+    """Scatter per-sequence KV into shuffled physical pages; return the paged
+    arrays, the q chunk, and dense (gathered) KV for the oracle."""
+    num_phys = 1 + B * max_pages  # page 0 = trash
+    k_pages = jnp.zeros((num_phys, Hkv, page_size, D), jnp.float32)
+    v_pages = jnp.zeros_like(k_pages)
+
+    # shuffled physical page assignment, like a real allocator under churn
+    perm = np.random.RandomState(0).permutation(num_phys - 1) + 1
+    page_table = np.zeros((B, max_pages), np.int32)
+    dense_max = max_pages * page_size
+    k_dense = np.zeros((B, dense_max, Hkv, D), np.float32)
+    v_dense = np.zeros_like(k_dense)
+
+    next_phys = 0
+    rng = np.random.RandomState(1)
+    for b in range(B):
+        n_pages = -(-ctx_lens[b] // page_size) if ctx_lens[b] else 0
+        for p in range(n_pages):
+            page_table[b, p] = perm[next_phys]
+            next_phys += 1
+        kb = rng.randn(ctx_lens[b], Hkv, D).astype(np.float32)
+        vb = rng.randn(ctx_lens[b], Hkv, D).astype(np.float32)
+        k_dense[b, : ctx_lens[b]] = kb
+        v_dense[b, : ctx_lens[b]] = vb
+        for t in range(ctx_lens[b]):
+            phys, off = page_table[b, t // page_size], t % page_size
+            k_pages = k_pages.at[phys, :, off].set(kb[t])
+            v_pages = v_pages.at[phys, :, off].set(vb[t])
+
+    q = jax.random.normal(key, (B, C, H, D), jnp.float32)
+    return q, k_pages, v_pages, jnp.asarray(page_table), jnp.asarray(k_dense), jnp.asarray(v_dense)
+
+
+def test_paged_decode_matches_reference():
+    """C=1 decode: ragged context lengths, shuffled pages, one inactive slot."""
+    B, H, Hkv, D, page_size, max_pages = 4, 8, 2, 64, 16, 8
+    ctx_lens = [37, 128, 5, 0]  # slot 3 inactive
+    q, k_pages, v_pages, page_table, k_dense, v_dense = _build_paged_case(
+        jax.random.key(4), B, H, Hkv, D, page_size, max_pages, ctx_lens, C=1
+    )
+    kv_len = jnp.asarray(ctx_lens, jnp.int32)
+    q_offset = jnp.maximum(kv_len - 1, 0)  # decode: q is the last cached token
+
+    out = paged_flash_attention(
+        q, k_pages, v_pages, page_table, q_offset, kv_len,
+        page_size=page_size, interpret=True,
+    )
+    ref = mha_reference(q, k_dense, v_dense, causal=True, q_offset=q_offset, kv_len=kv_len)
+    # inactive slot must be exactly zero (fully masked)
+    np.testing.assert_array_equal(np.asarray(out[3]), 0.0)
+    np.testing.assert_allclose(out[:3], ref[:3], atol=2e-5, rtol=2e-5)
+
+
+def test_paged_prefill_chunk_matches_reference():
+    """C>1 chunked prefill at an offset: chunk KV already scattered."""
+    B, H, Hkv, D, page_size, max_pages = 2, 4, 4, 64, 16, 8
+    C = 32
+    ctx_lens = [64, 96]  # total cached INCLUDING the current chunk
+    q, k_pages, v_pages, page_table, k_dense, v_dense = _build_paged_case(
+        jax.random.key(5), B, H, Hkv, D, page_size, max_pages, ctx_lens, C=C
+    )
+    kv_len = jnp.asarray(ctx_lens, jnp.int32)
+    q_offset = kv_len - C
+
+    out = paged_flash_attention(
+        q, k_pages, v_pages, page_table, q_offset, kv_len,
+        page_size=page_size, interpret=True,
+    )
+    ref = mha_reference(q, k_dense, v_dense, causal=True, q_offset=q_offset, kv_len=kv_len)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_paged_kernel_agrees_with_scatter_gather_path():
+    """End-to-end consistency with the engine's jnp path: scatter a chunk via
+    scatter_kv_chunk, then paged kernel == gather_kv + mha_reference."""
+    B, H, Hkv, D, page_size, max_pages = 2, 4, 2, 64, 16, 4
+    num_phys = 1 + B * max_pages
+    key = jax.random.key(6)
+    kk, kv_, kq = jax.random.split(key, 3)
+
+    k_pages = jnp.zeros((num_phys, Hkv, page_size, D), jnp.float32)
+    v_pages = jnp.zeros_like(k_pages)
+    page_table = jnp.asarray(
+        [[1, 2, 3, 4], [5, 6, 7, 8]], jnp.int32
+    )
+    C = 16
+    start_pos = jnp.array([0, 24], jnp.int32)
+    n_valid = jnp.array([16, 9], jnp.int32)
+
+    k_new = jax.random.normal(kk, (B, C, Hkv, D), jnp.float32)
+    v_new = jax.random.normal(kv_, (B, C, Hkv, D), jnp.float32)
+    k_pages, v_pages = scatter_kv_chunk(
+        k_pages, v_pages, k_new, v_new, page_table, start_pos, n_valid, page_size
+    )
+
+    q = jax.random.normal(kq, (B, C, H, D), jnp.float32)
+    kv_len = start_pos + n_valid
+
+    out = paged_flash_attention(
+        q, k_pages, v_pages, page_table, start_pos, kv_len,
+        page_size=page_size, interpret=True,
+    )
+    k_dense, v_dense = gather_kv(k_pages, v_pages, page_table, page_size)
+    ref = mha_reference(q, k_dense, v_dense, causal=True, q_offset=start_pos, kv_len=kv_len)
+    # rows beyond n_valid are padding; compare valid rows only
+    for b in range(B):
+        nv = int(n_valid[b])
+        np.testing.assert_allclose(out[b, :nv], ref[b, :nv], atol=2e-5, rtol=2e-5)
+
+
+def test_engine_end_to_end_pallas_backend():
+    """The engine's chunked prefill + decode must produce identical greedy
+    tokens whether attention runs through the jnp reference path or the
+    Pallas kernels (interpret mode on the CPU test mesh)."""
+    from finchat_tpu.engine.engine import InferenceEngine, commit_first_token
+    from finchat_tpu.engine.kv_cache import PageAllocator, pages_needed
+    from finchat_tpu.models.llama import PRESETS, init_params
+    from finchat_tpu.utils.config import EngineConfig
+
+    config = PRESETS["tiny"]
+    engine_cfg = EngineConfig(
+        max_seqs=2, page_size=8, num_pages=32, max_seq_len=64, prefill_chunk=8
+    )
+    params = init_params(config, jax.random.key(0))
+    prompt = [3, 7, 11, 200, 42, 9, 13, 55, 21, 8]  # 2 chunks
+    n_new = 6
+
+    def run(backend):
+        eng = InferenceEngine(config, params, engine_cfg, attn_backend=backend)
+        alloc = PageAllocator(engine_cfg.num_pages)
+        pages = alloc.allocate("s", pages_needed(len(prompt) + n_new, eng.page_size))
+        eng.set_page_table_row(0, pages)
+        logits = eng.prefill(0, prompt)
+        eng.state, tok = commit_first_token(
+            eng.state, jnp.int32(0), logits,
+            jnp.float32(0.0), jnp.float32(1.0), jnp.int32(0),
+        )
+        out = [int(tok)]
+        B = engine_cfg.max_seqs
+        active = jnp.zeros((B,), bool).at[0].set(True)
+        zeros, ones, zk = jnp.zeros((B,)), jnp.ones((B,)), jnp.zeros((B,), jnp.int32)
+        for _ in range(n_new - 1):
+            out.append(int(eng.decode(active, zeros, ones, zk)[0]))
+        return out
+
+    assert run("ref") == run("pallas-interpret")
