@@ -94,14 +94,16 @@ async def run_load(
     wall = time.perf_counter() - t_all0
 
     total_tokens = sum(tokens_out)
-    ttfts_a = np.asarray(sorted(ttfts))
-    p50 = float(np.percentile(ttfts_a, 50))
+    ttfts_a = np.asarray(ttfts)
+    failed = int(np.isnan(ttfts_a).sum())  # sessions that produced no tokens
+    p50 = float(np.nanpercentile(ttfts_a, 50)) if failed < len(ttfts) else float("nan")
     return {
         "metric": "ttft_p50_seconds",
         "value": round(p50, 4),
         "unit": "s",
         "vs_baseline": round(BASELINE_TTFT_P50_S / max(p50, 1e-9), 3),  # >1 = better
-        "ttft_p95_s": round(float(np.percentile(ttfts_a, 95)), 4),
+        "ttft_p95_s": round(float(np.nanpercentile(ttfts_a, 95)), 4) if failed < len(ttfts) else float("nan"),
+        "failed_sessions": failed,
         "throughput_tok_s": round(total_tokens / wall, 1),
         "sessions": sessions,
         "prompt_len": prompt_len,

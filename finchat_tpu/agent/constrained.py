@@ -27,7 +27,7 @@ server-side injection invariant).
 
 from __future__ import annotations
 
-from typing import Callable, Sequence
+from typing import Any, Callable, Sequence
 
 import numpy as np
 
@@ -104,13 +104,29 @@ def _string_char(ch: str) -> bool:
     return ch not in '"\\})' and (ch >= " ") and ch != "\x7f"
 
 
-NO_TOOL_LITERAL = "No tool call"
-TOOL_NAME = "retrieve_transactions"
+# single source of truth for tool names / literals / chart enum: the parser
+# module — grammar and validator must not drift apart (grammar ⊆ parser)
+from finchat_tpu.agent.toolcall import (  # noqa: E402
+    CHART_TYPES,
+    NO_TOOL_LITERAL,
+    PLOT_TOOL_NAME,
+    TOOL_NAME,
+)
 
-_KEYS: dict[str, str] = {
+# key -> value kind; kind is "string", "int", or a tuple of enum literals
+_RETRIEVAL_KEYS: dict[str, Any] = {
     "search_query": "string",
     "num_transactions": "int",
     "time_period_days": "int",
+}
+_PLOT_KEYS: dict[str, Any] = {
+    "chart_type": CHART_TYPES,
+    "title": "string",
+    **_RETRIEVAL_KEYS,  # plot data comes from a server-side retrieval
+}
+TOOL_GRAMMARS: dict[str, dict[str, Any]] = {
+    TOOL_NAME: _RETRIEVAL_KEYS,
+    PLOT_TOOL_NAME: _PLOT_KEYS,
 }
 
 
@@ -137,16 +153,9 @@ def _bound_whitespace(d: CharDFA, max_ws: int = 2) -> None:
             d.edges[prev].pop(ch, None)
 
 
-def build_tool_grammar() -> CharDFA:
-    """DFA for the tool-decision output contract (module docstring)."""
-    d = CharDFA()
-    d.edge(d.start, _WS, d.start)  # tolerate leading whitespace
-
-    # alternative 1: the no-tool literal (tool_prompt.txt:12), then EOS
-    d.literal(d.start, NO_TOOL_LITERAL, eos_ok=True)
-
-    # alternative 2: retrieve_transactions({...})
-    pre_obj = d.literal(d.start, TOOL_NAME + "(")
+def _add_tool_call(d: CharDFA, name: str, keys: dict[str, Any]) -> None:
+    """Add one ``name({...})`` alternative with its own key/value machine."""
+    pre_obj = d.literal(d.start, name + "(")
     d.edge(pre_obj, _WS, pre_obj)
     key_or_close = d.new_state()
     d.edge(pre_obj, "{", key_or_close)
@@ -169,7 +178,7 @@ def build_tool_grammar() -> CharDFA:
     d.edge(key_or_close, '"', key_start)
     d.edge(pre_key, '"', key_start)
 
-    for key, kind in _KEYS.items():
+    for key, kind in keys.items():
         key_end = d.literal(key_start, key)
         pre_colon = d.new_state()
         d.edge(key_end, '"', pre_colon)
@@ -182,14 +191,34 @@ def build_tool_grammar() -> CharDFA:
             d.edge(pre_val, '"', in_str)
             d.edge_class(in_str, _string_char, in_str)
             d.edge(in_str, '"', after_val)
-        else:  # positive int
+        elif isinstance(kind, tuple):  # enum of string literals
+            for value in kind:
+                d.literal(pre_val, f'"{value}"', dst=after_val)
+        else:  # positive int, JSON-valid (no leading zeros: 0 | [1-9][0-9]*)
             in_int = d.new_state()
-            d.edge(pre_val, "0123456789", in_int)
+            int_zero = d.new_state()
+            d.edge(pre_val, "0", int_zero)
+            d.edge(pre_val, "123456789", in_int)
             d.edge(in_int, "0123456789", in_int)
             # ints have no closing char: terminator edges double as after_val
-            d.edge(in_int, ",", pre_key)
-            d.edge(in_int, "}", obj_done)
-            d.edge(in_int, _WS, after_val)
+            for int_state in (in_int, int_zero):
+                d.edge(int_state, ",", pre_key)
+                d.edge(int_state, "}", obj_done)
+                d.edge(int_state, _WS, after_val)
+
+
+def build_tool_grammar() -> CharDFA:
+    """DFA for the tool-decision output contract (module docstring)."""
+    d = CharDFA()
+    d.edge(d.start, _WS, d.start)  # tolerate leading whitespace
+
+    # alternative 1: the no-tool literal (tool_prompt.txt:12), then EOS
+    d.literal(d.start, NO_TOOL_LITERAL, eos_ok=True)
+
+    # one alternative per tool: retrieve_transactions({...}) and
+    # create_financial_plot({...}) (SURVEY §7.2.7: the plot tool is wired)
+    for name, keys in TOOL_GRAMMARS.items():
+        _add_tool_call(d, name, keys)
     _bound_whitespace(d)
     return d
 
@@ -273,10 +302,12 @@ class GrammarVocab:
         self.dfa = dfa
         self.token_strs = list(token_strs)
         self.eos_id = eos_id
-        self._mask_cache: dict[int, tuple[np.ndarray, bool]] = {}
+        self._mask_cache: dict[int, tuple[np.ndarray, bool, np.ndarray]] = {}
         # token -> end-state transition cache, keyed by (state, token_id)
         self._step_cache: dict[tuple[int, int], int] = {}
         self.distance = _distance_to_accept(dfa)
+        # distance indexed by end-state row (DEAD row = unreachable sentinel)
+        self._distance_np = np.asarray(self.distance + [1 << 30], np.int64)
 
         # dense transitions: row per state + absorbing DEAD row (last)
         n = len(dfa.edges)
@@ -303,8 +334,13 @@ class GrammarVocab:
     def for_tokenizer(cls, tokenizer) -> "GrammarVocab":
         return cls(build_tool_grammar(), token_texts(tokenizer), tokenizer.eos_id)
 
-    def mask(self, state: int) -> tuple[np.ndarray, bool]:
-        """(allowed[vocab] bool, eos_allowed) for a DFA state."""
+    def mask(self, state: int) -> tuple[np.ndarray, bool, np.ndarray]:
+        """(allowed[vocab] bool, eos_allowed, end_state[vocab]) for a state.
+
+        ``end_state[t]`` is the DFA row after emitting token t (the DEAD row
+        when t is not allowed) — pick() uses it with ``distance`` to keep
+        generation inside the remaining token budget.
+        """
         cached = self._mask_cache.get(state)
         if cached is not None:
             return cached
@@ -315,8 +351,8 @@ class GrammarVocab:
             states = np.where(live, self._table[states, self._tok_bytes[:, j]], states)
         allowed = (states != self._dead_row) & (self._tok_lens > 0)
         eos_ok = state != DEAD and self.dfa.eos_ok[state]
-        self._mask_cache[state] = (allowed, eos_ok)
-        return allowed, eos_ok
+        self._mask_cache[state] = (allowed, eos_ok, states)
+        return allowed, eos_ok, states
 
     def advance(self, state: int, token_id: int) -> int:
         key = (state, token_id)
@@ -345,30 +381,29 @@ class TokenConstraint:
     ) -> int:
         """Sample one token from the grammar-masked logits and advance.
 
-        ``remaining`` (tokens left in the budget, this one included) arms
-        closing mode: when the budget approaches the state's char-distance to
-        an accepting state, only distance-decreasing tokens stay allowed —
-        generation is guaranteed to close the grammar before running out.
+        ``remaining`` (tokens left in the budget, this one included) arms the
+        feasibility invariant: a token is only allowed if its successor state
+        can still reach an accepting state within the budget left AFTER it
+        (chars-to-accept ≤ tokens-left - 1, since every token emits ≥1 char).
+        Maintained every step, this guarantees the grammar closes in time —
+        a one-shot "closing mode" is not enough, because distance-to-accept
+        can jump above the budget in a single step (e.g. opening a long key).
 
         Returns ``eos_id`` when the grammar is complete (or unsatisfiable —
         which degrades to the no-tool path downstream, never a crash).
         """
-        allowed, eos_ok = self.vocab.mask(self.state)
-        dist = self.vocab.distance[self.state]
-        if remaining is not None and remaining <= dist + 2:
-            if dist == 0:
-                return self.vocab.eos_id  # out of slack: close now
-            closing = np.zeros_like(allowed)
-            for tid in np.flatnonzero(allowed):
-                nxt = self.vocab.advance(self.state, int(tid))
-                if nxt != DEAD and self.vocab.distance[nxt] < dist:
-                    closing[tid] = True
-            if closing.any():
-                allowed = closing
+        allowed, eos_ok, ends = self.vocab.mask(self.state)
+        if remaining is not None:
+            feasible = allowed & (self.vocab._distance_np[ends] <= remaining - 2)
+            if feasible.any() or eos_ok:
+                allowed = feasible
             else:
-                logger.warning("no closing token at state %d; forcing EOS", self.state)
+                logger.warning(
+                    "no budget-feasible token at state %d (remaining=%d); forcing EOS",
+                    self.state, remaining,
+                )
                 return self.vocab.eos_id
-        elif eos_ok:
+        if eos_ok:
             allowed = allowed.copy()
             allowed[self.vocab.eos_id] = True
         if not allowed.any():

@@ -151,9 +151,16 @@ class LLMAgent:
         return state
 
     async def _retrieve_data_node(self, state: AgentState) -> AgentState:
-        """Node 2: execute retrieval. Only the first queued call is honored
+        """Node 2: execute the tool. Only the first queued call is honored
         (llm_agent.py:100,116); failure degrades to an Error marker and the
-        answer is still generated (llm_agent.py:129-131)."""
+        answer is still generated (llm_agent.py:129-131).
+
+        ``create_financial_plot`` (SURVEY §7.2.7 — wired here, dead code in
+        the reference) runs a server-side retrieval for its data (the model
+        never supplies rows), charts rows that have the y-field, and still
+        populates ``retrieved_transactions`` so the response model can
+        discuss the same data the chart shows.
+        """
         logger.info("Retrieving transaction data")
         if not state.tool_calls:
             return state
@@ -161,11 +168,29 @@ class LLMAgent:
             tool_call = state.tool_calls.popleft()
             tool_args = dict(tool_call.args)
             tool_args["user_id"] = state.user_id  # server-side injection, never model-chosen
-            transactions = await self.retriever(tool_args)
-            state.retrieved_transactions = transactions
-            logger.info("Retrieved %d transactions", len(transactions))
+            if tool_call.name == "create_financial_plot" and hasattr(self.retriever, "structured"):
+                rows = await self.retriever.structured(tool_args)
+                state.retrieved_transactions = [r["page_content"] for r in rows]
+                chartable = [r for r in rows if "amount" in r]
+                if chartable:
+                    import asyncio as _asyncio
+                    import json as _json
+
+                    from finchat_tpu.tools.plot import PlotConfig, create_financial_plot
+
+                    state.plot_data_uri = await _asyncio.to_thread(
+                        create_financial_plot,
+                        _json.dumps(chartable),
+                        # chart_type/title are guaranteed by _validate_plot_args
+                        PlotConfig(chart_type=tool_args["chart_type"], title=tool_args["title"]),
+                    )
+                else:
+                    logger.warning("plot requested but no rows carry an 'amount' field")
+            else:
+                state.retrieved_transactions = await self.retriever(tool_args)
+            logger.info("Retrieved %d transactions", len(state.retrieved_transactions))
         except Exception as e:
-            logger.error("Error retrieving transactions: %s", e)
+            logger.error("Error running tool: %s", e)
             state.retrieved_transactions = [f"Error: {e}"]
         return state
 
@@ -206,6 +231,7 @@ class LLMAgent:
         return {
             "response": final_state.final_response,
             "retrieved_transactions_count": len(final_state.retrieved_transactions),
+            "plot_data_uri": final_state.plot_data_uri,
             "state": final_state,
         }
 
@@ -240,6 +266,8 @@ class LLMAgent:
                 "count": len(state.retrieved_transactions),
                 "message": f"Retrieved {len(state.retrieved_transactions)} transactions",
             }
+            if state.plot_data_uri:
+                yield {"type": "plot", "data_uri": state.plot_data_uri}
         else:
             yield {"type": "status", "message": "No transaction data retrieval needed"}
 

@@ -28,4 +28,5 @@ class AgentState:
     chat_history: list[ChatMessage] = field(default_factory=list)
     tool_calls: deque[ToolCall] = field(default_factory=deque)
     retrieved_transactions: list[str] = field(default_factory=list)
+    plot_data_uri: str | None = None  # create_financial_plot output
     final_response: str | None = None

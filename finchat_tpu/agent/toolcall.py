@@ -6,13 +6,17 @@ so the call format is parsed — strictly — from the model output, honoring
 the prompt contract (``tool_prompt.txt``):
 
 - the literal ``No tool call`` (tool_prompt.txt:12 parity) → no retrieval;
-- ``retrieve_transactions({...json...})`` → a validated ToolCall.
+- ``retrieve_transactions({...json...})`` → a validated ToolCall;
+- ``create_financial_plot({...json...})`` → a validated ToolCall (the
+  reference ships this tool as dead code, tools/plot_tool.py — here it is
+  wired; SURVEY §7.2.7).
 
 Validation mirrors the reference's RetrievalIntent schema
 (``tools/qdrant_tool.py:39-68``): ``num_transactions`` bounded 1..10000,
 ``time_period_days`` a positive int, ``search_query`` a string defaulting to
-"recent transactions". ``user_id`` is NEVER taken from the model — the
-executor overwrites it server-side (llm_agent.py:119-120 invariant).
+"recent transactions"; plot args add ``chart_type`` (whitelisted) and
+``title``. ``user_id`` is NEVER taken from the model — the executor
+overwrites it server-side (llm_agent.py:119-120 invariant).
 """
 
 from __future__ import annotations
@@ -26,12 +30,17 @@ from finchat_tpu.utils.logging import get_logger
 logger = get_logger(__name__)
 
 TOOL_NAME = "retrieve_transactions"
+PLOT_TOOL_NAME = "create_financial_plot"
 NO_TOOL_LITERAL = "No tool call"
 
-_CALL_RE = re.compile(r"retrieve_transactions\s*\(\s*(\{.*?\})\s*\)", re.DOTALL)
+CHART_TYPES = ("line", "bar", "pie", "scatter", "histogram")
+
+_CALL_RE = re.compile(
+    r"(retrieve_transactions|create_financial_plot)\s*\(\s*(\{.*?\})\s*\)", re.DOTALL
+)
 
 
-def _validate_args(raw: dict) -> dict:
+def _validate_retrieval_args(raw: dict) -> dict:
     args: dict = {}
     sq = raw.get("search_query")
     args["search_query"] = sq if isinstance(sq, str) and sq.strip() else "recent transactions"
@@ -52,6 +61,21 @@ def _validate_args(raw: dict) -> dict:
     return args
 
 
+def _validate_plot_args(raw: dict) -> dict:
+    args = _validate_retrieval_args(raw)
+    chart = raw.get("chart_type")
+    args["chart_type"] = chart if chart in CHART_TYPES else "bar"
+    title = raw.get("title")
+    args["title"] = title if isinstance(title, str) and title.strip() else "Financial Plot"
+    return args
+
+
+_VALIDATORS = {
+    TOOL_NAME: _validate_retrieval_args,
+    PLOT_TOOL_NAME: _validate_plot_args,
+}
+
+
 def parse_tool_decision(text: str) -> ToolCall | None:
     """Parse the tool-decision model output into a ToolCall, or None."""
     stripped = text.strip()
@@ -60,18 +84,21 @@ def parse_tool_decision(text: str) -> ToolCall | None:
 
     match = _CALL_RE.search(stripped)
     if match is None:
-        if TOOL_NAME in stripped:
-            # named the tool but args are malformed → retrieve with defaults
-            logger.warning("tool call named without parsable args: %r", stripped[:120])
-            return ToolCall(name=TOOL_NAME, args=_validate_args({}))
+        for name in (TOOL_NAME, PLOT_TOOL_NAME):
+            if name in stripped:
+                # named a tool but args are malformed → call with defaults
+                logger.warning("tool call named without parsable args: %r", stripped[:120])
+                return ToolCall(name=name, args=_VALIDATORS[name]({}))
         return None
 
+    name = match.group(1)
+    validator = _VALIDATORS[name]
     try:
-        raw = json.loads(match.group(1))
+        raw = json.loads(match.group(2))
     except json.JSONDecodeError:
-        logger.warning("unparsable tool-call JSON: %r", match.group(1)[:120])
-        return ToolCall(name=TOOL_NAME, args=_validate_args({}))
+        logger.warning("unparsable tool-call JSON: %r", match.group(2)[:120])
+        return ToolCall(name=name, args=validator({}))
 
     if not isinstance(raw, dict):
-        return ToolCall(name=TOOL_NAME, args=_validate_args({}))
-    return ToolCall(name=TOOL_NAME, args=_validate_args(raw))
+        return ToolCall(name=name, args=validator({}))
+    return ToolCall(name=name, args=validator(raw))

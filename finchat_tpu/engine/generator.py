@@ -45,13 +45,16 @@ class EngineGenerator:
 
         if grammar != "tool_call":
             raise ValueError(f"unknown grammar {grammar!r}")
-        vocab = self._grammar_vocabs.get(grammar)
-        if vocab is None:
-            # one-time O(vocab) build (token decode + dense DFA table): off
-            # the event loop so in-flight decodes aren't stalled
-            vocab = await asyncio.to_thread(GrammarVocab.for_tokenizer, self.tokenizer)
-            self._grammar_vocabs[grammar] = vocab
-        return TokenConstraint(vocab)
+        # single-flight: cache the build TASK, not the result, so concurrent
+        # first requests share one O(vocab) build (token decode + dense DFA
+        # table), run off the event loop so in-flight decodes aren't stalled
+        task = self._grammar_vocabs.get(grammar)
+        if task is None:
+            task = asyncio.ensure_future(
+                asyncio.to_thread(GrammarVocab.for_tokenizer, self.tokenizer)
+            )
+            self._grammar_vocabs[grammar] = task
+        return TokenConstraint(await task)
 
     async def stream(self, prompt: str, sampling: SamplingParams) -> AsyncIterator[str]:
         prompt_ids = self.tokenizer.encode(prompt, add_bos=True)

@@ -64,6 +64,22 @@ def complete_chunk(message_value: dict[str, Any]) -> dict[str, Any]:
     }
 
 
+def plot_chunk(message_value: dict[str, Any], data_uri: str) -> dict[str, Any]:
+    """Chart chunk (NEW capability — no reference counterpart; the reference
+    ships its plot tool unwired, tools/plot_tool.py). Additive: same envelope
+    as a response chunk with ``type: "plot"`` and the PNG data-URI as the
+    message body, so consumers that only know response_chunk/complete ignore
+    it safely."""
+    return {
+        **message_value,
+        "message": data_uri,
+        "last_message": False,
+        "error": False,
+        "sender": AI_SENDER,
+        "type": "plot",
+    }
+
+
 def error_chunk(message_value: dict[str, Any]) -> dict[str, Any]:
     """Error marker (reference main.py:114-120). Intentionally has NO
     ``type`` field and an empty ``message``."""

@@ -33,7 +33,13 @@ from finchat_tpu.engine.engine import InferenceEngine
 from finchat_tpu.engine.sampler import SamplingParams
 from finchat_tpu.engine.scheduler import ContinuousBatchingScheduler
 from finchat_tpu.io.kafka import KafkaClient
-from finchat_tpu.io.schemas import complete_chunk, error_chunk, response_chunk, timeout_chunk
+from finchat_tpu.io.schemas import (
+    complete_chunk,
+    error_chunk,
+    plot_chunk,
+    response_chunk,
+    timeout_chunk,
+)
 from finchat_tpu.io.store import ConversationStore, make_store
 from finchat_tpu.models.llama import PRESETS, init_params
 from finchat_tpu.models.tokenizer import get_tokenizer
@@ -152,10 +158,13 @@ class App:
         user_context, _ = await self.store.get_context(payload["conversation_id"])
         chat_history = await self.store.get_history(payload["conversation_id"])
         result = await self.agent.query(payload["message"], payload["user_id"], user_context, chat_history)
-        return Response.json({
+        body = {
             "response": result["response"],
             "retrieved_transactions_count": result["retrieved_transactions_count"],
-        })
+        }
+        if result.get("plot_data_uri"):
+            body["plot_data_uri"] = result["plot_data_uri"]
+        return Response.json(body)
 
     async def chat_stream(self, request: Request) -> Response | StreamingResponse:
         """SSE stream of the full internal event protocol."""
@@ -198,13 +207,19 @@ class App:
                         AI_RESPONSE_TOPIC, conversation_id, response_chunk(message_value, chunk_text)
                     )
                     logger.debug("Processed chunk: %s", chunk_text)
+                elif update["type"] == "plot":
+                    # NEW capability (additive chunk type; schemas.plot_chunk)
+                    self.kafka.produce_message(
+                        AI_RESPONSE_TOPIC, conversation_id, plot_chunk(message_value, update["data_uri"])
+                    )
                 elif update["type"] == "complete":
                     self.kafka.produce_message(
                         AI_RESPONSE_TOPIC, conversation_id, complete_chunk(message_value)
                     )
                     logger.info("Complete message sent to Kafka for conversation %s", conversation_id)
                 # status / retrieval_complete events are intentionally NOT
-                # forwarded (main.py:81-110 forwards only these two types)
+                # forwarded (main.py:81-110 forwards only response_chunk +
+                # complete; plot is the one additive extension)
         except Exception as e:
             logger.error("Error streaming LLM response: %s", e)
             self.kafka.produce_error_message(

@@ -45,6 +45,12 @@ class TransactionRetriever:
         self.now = now
 
     async def __call__(self, args: dict[str, Any]) -> list[str]:
+        return [row["page_content"] for row in await self.structured(args)]
+
+    async def structured(self, args: dict[str, Any]) -> list[dict[str, Any]]:
+        """Like ``__call__`` but returns full rows (page_content + metadata
+        fields) — the data source for ``create_financial_plot``, which needs
+        structured x/y fields, not rendered text."""
         try:
             user_id = args.get("user_id", "")
             logger.info("Starting transaction retrieval for user_id: %s", user_id)
@@ -64,14 +70,14 @@ class TransactionRetriever:
                 query_vector, limit=int(limit), user_id=user_id, date_gte=date_gte
             )
 
-            transactions: list[str] = []
+            rows: list[dict[str, Any]] = []
             skipped = 0
             for hit in hits:
                 payload = hit.payload
                 metadata = hit.metadata
                 # post-hoc security re-check, parity with qdrant_tool.py:159-170
                 if payload and metadata.get("user_id") == user_id:
-                    transactions.append(payload["page_content"])
+                    rows.append({**metadata, "page_content": payload["page_content"]})
                 else:
                     skipped += 1
                     logger.warning(
@@ -83,15 +89,24 @@ class TransactionRetriever:
                 METRICS.inc("finchat_retrieval_security_skips_total", skipped)
 
             METRICS.inc("finchat_retrievals_total")
-            logger.info("Successfully processed %d transactions", len(transactions))
-            return transactions
+            logger.info("Successfully processed %d transactions", len(rows))
+            return rows
         except Exception as e:
             logger.error("Error retrieving transactions: %s", e, exc_info=True)
             return []
 
     # --- ingestion side (the reference's upsert path lives out-of-repo;
     # here it is first-class so the product is self-contained) ------------
-    def upsert_transactions(self, user_id: str, texts: list[str], dates: list[float] | None = None) -> None:
+    def upsert_transactions(
+        self,
+        user_id: str,
+        texts: list[str],
+        dates: list[float] | None = None,
+        metadatas: list[dict[str, Any]] | None = None,
+    ) -> None:
+        """``metadatas`` (e.g. ``{"amount": -12.5, "category": "coffee"}``)
+        merge into each point's metadata — the structured fields the plot
+        tool charts."""
         from finchat_tpu.embed.index import VectorPoint
 
         vectors = self.encoder.embed_batch(texts)
@@ -100,7 +115,14 @@ class TransactionRetriever:
             VectorPoint(
                 id=f"{user_id}-{i}-{int(dates[i])}",
                 vector=vectors[i],
-                payload={"page_content": texts[i], "metadata": {"user_id": user_id, "date": dates[i]}},
+                payload={
+                    "page_content": texts[i],
+                    "metadata": {
+                        **(metadatas[i] if metadatas else {}),
+                        "user_id": user_id,
+                        "date": dates[i],
+                    },
+                },
             )
             for i in range(len(texts))
         ]

@@ -113,3 +113,50 @@ async def test_retrieved_data_lands_in_response_prompt():
     prompt = response_stub.calls[0]
     assert "Retrieved Transaction Data:" in prompt
     assert "COFFEE $4" in prompt and "RENT $2000" in prompt
+
+
+def test_plot_tool_call_renders_chart_and_streams_event():
+    """create_financial_plot through the agent: server-side structured
+    retrieval feeds the chart; the stream emits a plot event; user_id is
+    injected server-side."""
+    import asyncio
+
+    from finchat_tpu.agent.graph import LLMAgent
+    from finchat_tpu.engine.generator import StubGenerator
+
+    class FakeStructuredRetriever:
+        def __init__(self):
+            self.calls = []
+
+        async def __call__(self, args):
+            return [r["page_content"] for r in await self.structured(args)]
+
+        async def structured(self, args):
+            self.calls.append(args)
+            return [
+                {"page_content": "coffee $4", "amount": 4.0, "date": 1.0, "user_id": args["user_id"]},
+                {"page_content": "coffee $5", "amount": 5.0, "date": 2.0, "user_id": args["user_id"]},
+            ]
+
+    retriever = FakeStructuredRetriever()
+    tool_gen = StubGenerator(
+        default='create_financial_plot({"chart_type": "bar", "title": "Coffee", "search_query": "coffee"})'
+    )
+    agent = LLMAgent(tool_gen, StubGenerator(default="Here is your chart."),
+                     retriever, "sys", "tool")
+
+    async def run():
+        events = []
+        async for ev in agent.stream_with_status("chart my coffee", "u1"):
+            events.append(ev)
+        return events
+
+    events = asyncio.run(run())
+    plot_events = [e for e in events if e["type"] == "plot"]
+    assert len(plot_events) == 1
+    assert plot_events[0]["data_uri"].startswith("data:image/png;base64,")
+    assert retriever.calls[0]["user_id"] == "u1"
+    assert retriever.calls[0]["chart_type"] == "bar"
+    # batch path carries the chart too
+    result = asyncio.run(agent.query("chart my coffee", "u1"))
+    assert result["plot_data_uri"].startswith("data:image/png;base64,")

@@ -62,7 +62,7 @@ def test_grammar_accepts_valid_outputs(dfa, text):
         'retrieve_transactions({"search_query": 5})',  # wrong value type
         'retrieve_transactions({"num_transactions": "many"})',
         "retrieve_transactions({}) extra",  # trailing junk
-        'create_financial_plot({})',  # unknown tool
+        'make_coffee({})',  # unknown tool
     ],
 )
 def test_grammar_rejects_invalid_outputs(dfa, text):
@@ -97,7 +97,7 @@ def test_every_accepted_output_parses():
 def test_start_mask_byte_vocab():
     tok = ByteTokenizer()
     vocab = GrammarVocab.for_tokenizer(tok)
-    allowed, eos_ok = vocab.mask(vocab.dfa.start)
+    allowed, eos_ok, _ = vocab.mask(vocab.dfa.start)
     assert not eos_ok  # empty output is not grammatical
     assert allowed[ord("N")] and allowed[ord("r")] and allowed[ord(" ")]
     assert not allowed[ord("H")] and not allowed[ord("{")]
@@ -221,10 +221,10 @@ def test_grammar_vocab_multitoken_literal_with_sp_texts():
     from finchat_tpu.agent.constrained import GrammarVocab, build_tool_grammar
 
     vocab = GrammarVocab(build_tool_grammar(), ["", "No", " tool", " call", "xx"], eos_id=0)
-    allowed, _ = vocab.mask(vocab.dfa.start)
+    allowed, _, _ = vocab.mask(vocab.dfa.start)
     assert allowed[1] and not allowed[4] and not allowed[0]
     s = vocab.advance(vocab.dfa.start, 1)  # "No"
-    allowed, _ = vocab.mask(s)
+    allowed, _, _ = vocab.mask(s)
     assert allowed[2]  # " tool"
     s = vocab.advance(s, 2)
     s = vocab.advance(s, 3)  # " call"
@@ -238,3 +238,38 @@ def test_string_values_exclude_parser_breaking_chars():
     bad = 'retrieve_transactions({"search_query": "food} 2024"})'
     prefix = bad[: bad.index("}") + 1]  # up to and including the in-string '}'
     assert not is_live_prefix(dfa, prefix)
+
+
+@pytest.mark.parametrize(
+    "text",
+    [
+        'create_financial_plot({"chart_type": "bar", "title": "Spending This Month", "search_query": "all purchases", "time_period_days": 30})',
+        'create_financial_plot({"chart_type": "pie"})',
+        "create_financial_plot({})",
+    ],
+)
+def test_grammar_accepts_plot_calls(dfa, text):
+    assert accepts(dfa, text)
+
+
+@pytest.mark.parametrize(
+    "text",
+    [
+        'create_financial_plot({"chart_type": "donut"})',  # not in the enum
+        'create_financial_plot({"chart_type": bar})',  # unquoted enum
+    ],
+)
+def test_grammar_rejects_bad_plot_calls(dfa, text):
+    assert not accepts(dfa, text)
+
+
+def test_plot_call_parses_with_validation():
+    call = parse_tool_decision(
+        'create_financial_plot({"chart_type": "pie", "title": "Food", "num_transactions": 50})'
+    )
+    assert call is not None and call.name == "create_financial_plot"
+    assert call.args["chart_type"] == "pie" and call.args["title"] == "Food"
+    assert call.args["num_transactions"] == 50
+    # bad chart type degrades to the default, never an error
+    call = parse_tool_decision('create_financial_plot({"chart_type": "donut"})')
+    assert call.args["chart_type"] == "bar"
