@@ -12,9 +12,13 @@ FROM python:3.12-slim
 RUN apt-get update && apt-get install -y --no-install-recommends curl \
     && rm -rf /var/lib/apt/lists/*
 
-# jax[tpu] resolves libtpu on TPU VMs; CPU fallback works out of the box
+# jax[tpu] resolves libtpu on TPU VMs; CPU fallback works out of the box.
+# matplotlib: the wired plot tool; orbax: native checkpoints; the serve
+# extras (confluent-kafka, pymongo, qdrant-client) are the reference-parity
+# external backends.
 RUN pip install --no-cache-dir "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
-    && pip install --no-cache-dir safetensors transformers
+    && pip install --no-cache-dir safetensors transformers matplotlib orbax-checkpoint \
+       confluent-kafka pymongo qdrant-client
 
 WORKDIR /app
 COPY pyproject.toml ./

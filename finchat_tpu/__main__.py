@@ -47,6 +47,15 @@ def main() -> None:
 
     async def run() -> None:
         loop = asyncio.get_running_loop()
+        import os
+
+        if os.getenv("FINCHAT_DEV"):
+            # SURVEY §5.2: the reference blocks its event loop (sync pymongo
+            # in async defs, blocking consumer.poll); dev mode makes any such
+            # regression here loudly visible instead of silently copied
+            loop.set_debug(True)
+            loop.slow_callback_duration = 0.1
+            logger.info("dev diagnostics on: asyncio debug + slow-callback detection")
         stop = asyncio.Event()
         for sig in (signal.SIGINT, signal.SIGTERM):
             loop.add_signal_handler(sig, stop.set)

@@ -173,13 +173,14 @@ class LLMAgent:
                 state.retrieved_transactions = [r["page_content"] for r in rows]
                 chartable = [r for r in rows if "amount" in r]
                 if chartable:
-                    import asyncio as _asyncio
                     import json as _json
 
                     from finchat_tpu.tools.plot import PlotConfig, create_financial_plot
 
-                    state.plot_data_uri = await _asyncio.to_thread(
-                        create_financial_plot,
+                    # synchronous by design: the render is cheap (Agg, ≤10k
+                    # rows) and matplotlib off the main thread has segfaulted
+                    # the worker (see tools/plot.py)
+                    state.plot_data_uri = create_financial_plot(
                         _json.dumps(chartable),
                         # chart_type/title are guaranteed by _validate_plot_args
                         PlotConfig(chart_type=tool_args["chart_type"], title=tool_args["title"]),

@@ -29,11 +29,11 @@ from finchat_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
+from finchat_tpu.tools.plot import CHART_TYPES  # single source of the enum
+
 TOOL_NAME = "retrieve_transactions"
 PLOT_TOOL_NAME = "create_financial_plot"
 NO_TOOL_LITERAL = "No tool call"
-
-CHART_TYPES = ("line", "bar", "pie", "scatter", "histogram")
 
 _CALL_RE = re.compile(
     r"(retrieve_transactions|create_financial_plot)\s*\(\s*(\{.*?\})\s*\)", re.DOTALL

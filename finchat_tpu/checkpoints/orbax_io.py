@@ -1,0 +1,86 @@
+"""Native sharded checkpoints via Orbax/tensorstore.
+
+SURVEY §5.4: the reference has no model weights, so checkpointing is new
+framework surface. Two layers:
+
+- ``hf_loader`` converts a HuggingFace safetensors directory once (one-way,
+  CPU-heavy transposes + stacking);
+- this module persists/loads the CONVERTED stacked pytree natively, with
+  per-shard tensorstore streams — so a server boot restores an 8B/70B param
+  tree directly onto its mesh placement (each host reads only its shards,
+  resumable on failure), instead of re-converting HF every start.
+
+Also covers training resume: ``TrainState`` (params + optimizer state +
+step) round-trips the same way, preserving shardings.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any
+
+import jax
+
+from finchat_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.StandardCheckpointer()
+
+
+def save_pytree(path: str | Path, tree: Any) -> None:
+    """Persist a pytree of jax arrays (sharded or not) to ``path``.
+
+    Each device's shards stream to tensorstore; the write is atomic (Orbax
+    finalizes via rename) so a crashed save never leaves a half checkpoint
+    that restore would accept.
+    """
+    path = Path(path).resolve()
+    ckptr = _checkpointer()
+    ckptr.save(path, tree, force=True)
+    ckptr.wait_until_finished()
+    logger.info("checkpoint saved to %s", path)
+
+
+def restore_pytree(path: str | Path, like: Any) -> Any:
+    """Restore a pytree saved by ``save_pytree``.
+
+    ``like`` supplies structure/shape/dtype AND placement: pass a pytree of
+    ``jax.ShapeDtypeStruct``s carrying ``sharding`` (or concrete arrays) and
+    each process reads exactly its own shards from the store.
+    """
+    path = Path(path).resolve()
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=getattr(x, "sharding", None))
+        if not isinstance(x, jax.ShapeDtypeStruct) else x,
+        like,
+    )
+    ckptr = _checkpointer()
+    restored = ckptr.restore(path, abstract)
+    logger.info("checkpoint restored from %s", path)
+    return restored
+
+
+def save_train_state(path: str | Path, state: Any) -> None:
+    """Persist a train/train_step.TrainState (params, opt_state, step)."""
+    save_pytree(
+        Path(path) / "train_state",
+        {"params": state.params, "opt_state": state.opt_state, "step": state.step},
+    )
+
+
+def restore_train_state(path: str | Path, like_state: Any):
+    """Restore into the structure of ``like_state`` (same optimizer config)."""
+    from finchat_tpu.train.train_step import TrainState
+
+    restored = restore_pytree(
+        Path(path) / "train_state",
+        {"params": like_state.params, "opt_state": like_state.opt_state, "step": like_state.step},
+    )
+    return TrainState(
+        params=restored["params"], opt_state=restored["opt_state"], step=restored["step"]
+    )

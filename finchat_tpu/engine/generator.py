@@ -54,7 +54,15 @@ class EngineGenerator:
                 asyncio.to_thread(GrammarVocab.for_tokenizer, self.tokenizer)
             )
             self._grammar_vocabs[grammar] = task
-        return TokenConstraint(await task)
+        try:
+            vocab = await task
+        except Exception:
+            # evict the failed build so the next request retries instead of
+            # re-raising a stale error forever
+            if self._grammar_vocabs.get(grammar) is task:
+                del self._grammar_vocabs[grammar]
+            raise
+        return TokenConstraint(vocab)
 
     async def stream(self, prompt: str, sampling: SamplingParams) -> AsyncIterator[str]:
         prompt_ids = self.tokenizer.encode(prompt, add_bos=True)

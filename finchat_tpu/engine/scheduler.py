@@ -35,6 +35,7 @@ if TYPE_CHECKING:  # engine must not import the agent layer at runtime
     from finchat_tpu.agent.constrained import TokenConstraint
 from finchat_tpu.engine.kv_cache import PageAllocator, pages_needed
 from finchat_tpu.engine.sampler import SamplingParams
+from finchat_tpu.utils.faults import inject
 from finchat_tpu.utils.logging import get_logger
 from finchat_tpu.utils.metrics import METRICS
 
@@ -174,6 +175,7 @@ class ContinuousBatchingScheduler:
             self._finish(handle, reason)
 
     def _prefill_one_chunk(self, handle: SequenceHandle) -> None:
+        inject("scheduler.prefill", seq_id=handle.seq_id)
         eng = self.engine
         C = eng.engine_cfg.prefill_chunk
         chunk = handle.prompt_ids[handle.prefill_pos : handle.prefill_pos + C]
@@ -216,6 +218,7 @@ class ContinuousBatchingScheduler:
             handle.events.put_nowait({"type": "token", "token_id": token_id})
 
     def _decode_once(self) -> None:
+        inject("scheduler.decode")
         eng = self.engine
         B = eng.engine_cfg.max_seqs
         active = np.zeros((B,), bool)

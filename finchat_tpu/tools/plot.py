@@ -1,9 +1,16 @@
 """create_financial_plot — chart generation over transaction data.
 
 The reference ships this tool as dead code (``tools/plot_tool.py``, never
-imported — SURVEY §2.1); here it is implemented and importable. Renders
-line/bar/pie/scatter/histogram charts from a JSON list of transactions and
-returns a base64 PNG data-URI, matching the reference tool's contract.
+imported — SURVEY §2.1); here it is implemented and wired into the agent.
+Renders line/bar/pie/scatter/histogram charts from a JSON list of
+transactions and returns a base64 PNG data-URI, matching the reference
+tool's contract.
+
+Implementation notes: pure stdlib + numpy + matplotlib(Agg) — deliberately
+NO pandas: DataFrame construction off the main thread segfaults
+intermittently (pyarrow string arrays are not thread-safe), and the chart
+path must never be able to take down the singleton TPU worker. Rendering is
+cheap (≤10k rows, Agg backend) and runs synchronously on the caller.
 """
 
 from __future__ import annotations
@@ -11,6 +18,7 @@ from __future__ import annotations
 import base64
 import io
 import json
+import threading
 from dataclasses import dataclass
 from typing import Any
 
@@ -18,7 +26,13 @@ from finchat_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
-_CHART_TYPES = ("line", "bar", "pie", "scatter", "histogram")
+# Canonical chart-type enum: the grammar (agent/constrained.py) and the
+# validator (agent/toolcall.py) both import this, so the three layers
+# cannot drift.
+CHART_TYPES = ("line", "bar", "pie", "scatter", "histogram")
+
+# matplotlib's pyplot state machine is not thread-safe; serialize renders
+_RENDER_LOCK = threading.Lock()
 
 
 @dataclass
@@ -31,6 +45,14 @@ class PlotConfig:
     title: str = "Financial Plot"
 
 
+def _columns(rows: list[dict], fields: tuple[str, ...]) -> dict[str, list]:
+    for field_name in fields:
+        missing = [r for r in rows if field_name not in r]
+        if missing:
+            raise ValueError(f"field {field_name!r} missing from transactions")
+    return {f: [r[f] for r in rows] for f in fields}
+
+
 def create_financial_plot(transactions_json: str, config: PlotConfig | None = None) -> str:
     """Render a chart from transaction JSON → ``data:image/png;base64,...``.
 
@@ -41,43 +63,43 @@ def create_financial_plot(transactions_json: str, config: PlotConfig | None = No
 
     matplotlib.use("Agg")  # headless
     import matplotlib.pyplot as plt
-    import pandas as pd
 
     cfg = config or PlotConfig()
-    if cfg.chart_type not in _CHART_TYPES:
-        raise ValueError(f"unknown chart_type {cfg.chart_type!r}; expected one of {_CHART_TYPES}")
+    if cfg.chart_type not in CHART_TYPES:
+        raise ValueError(f"unknown chart_type {cfg.chart_type!r}; expected one of {CHART_TYPES}")
 
     rows: Any = json.loads(transactions_json)
-    if not isinstance(rows, list) or not rows:
-        raise ValueError("transactions_json must be a non-empty JSON list")
-    frame = pd.DataFrame(rows)
-    for column in (cfg.x_field, cfg.y_field) if cfg.chart_type != "histogram" else (cfg.y_field,):
-        if column not in frame.columns:
-            raise ValueError(f"field {column!r} missing from transactions")
+    if not isinstance(rows, list) or not rows or not all(isinstance(r, dict) for r in rows):
+        raise ValueError("transactions_json must be a non-empty JSON list of objects")
+    fields = (cfg.y_field,) if cfg.chart_type == "histogram" else (cfg.x_field, cfg.y_field)
+    cols = _columns(rows, fields)
 
-    fig, ax = plt.subplots(figsize=(8, 5))
-    try:
-        if cfg.chart_type == "line":
-            ax.plot(frame[cfg.x_field], frame[cfg.y_field])
-        elif cfg.chart_type == "bar":
-            ax.bar(frame[cfg.x_field].astype(str), frame[cfg.y_field])
-        elif cfg.chart_type == "scatter":
-            ax.scatter(frame[cfg.x_field], frame[cfg.y_field])
-        elif cfg.chart_type == "histogram":
-            ax.hist(frame[cfg.y_field], bins=min(20, max(5, len(frame) // 2)))
-        elif cfg.chart_type == "pie":
-            grouped = frame.groupby(cfg.x_field)[cfg.y_field].sum().abs()
-            ax.pie(grouped.values, labels=[str(l) for l in grouped.index], autopct="%1.1f%%")
-        if cfg.chart_type != "pie":
-            ax.set_xlabel(cfg.x_field)
-            ax.set_ylabel(cfg.y_field)
-            fig.autofmt_xdate(rotation=30)
-        ax.set_title(cfg.title)
-        buf = io.BytesIO()
-        fig.savefig(buf, format="png", dpi=100, bbox_inches="tight")
-    finally:
-        plt.close(fig)
+    with _RENDER_LOCK:
+        fig, ax = plt.subplots(figsize=(8, 5))
+        try:
+            if cfg.chart_type == "line":
+                ax.plot(cols[cfg.x_field], cols[cfg.y_field])
+            elif cfg.chart_type == "bar":
+                ax.bar([str(x) for x in cols[cfg.x_field]], cols[cfg.y_field])
+            elif cfg.chart_type == "scatter":
+                ax.scatter(cols[cfg.x_field], cols[cfg.y_field])
+            elif cfg.chart_type == "histogram":
+                ax.hist(cols[cfg.y_field], bins=min(20, max(5, len(rows) // 2)))
+            elif cfg.chart_type == "pie":
+                totals: dict[str, float] = {}
+                for x, y in zip(cols[cfg.x_field], cols[cfg.y_field]):
+                    totals[str(x)] = totals.get(str(x), 0.0) + abs(float(y))
+                ax.pie(list(totals.values()), labels=list(totals.keys()), autopct="%1.1f%%")
+            if cfg.chart_type != "pie":
+                ax.set_xlabel(cfg.x_field)
+                ax.set_ylabel(cfg.y_field)
+                fig.autofmt_xdate(rotation=30)
+            ax.set_title(cfg.title)
+            buf = io.BytesIO()
+            fig.savefig(buf, format="png", dpi=100, bbox_inches="tight")
+        finally:
+            plt.close(fig)
 
     encoded = base64.b64encode(buf.getvalue()).decode("ascii")
-    logger.info("rendered %s chart (%d rows, %d png bytes)", cfg.chart_type, len(frame), len(buf.getvalue()))
+    logger.info("rendered %s chart (%d rows, %d png bytes)", cfg.chart_type, len(rows), len(buf.getvalue()))
     return f"data:image/png;base64,{encoded}"
