@@ -1,0 +1,40 @@
+"""Cheap TPU-tunnel liveness probe for the single-client environment.
+
+Backend init over this box's TPU tunnel hangs indefinitely when another
+client holds (or recently wedged) the lease, so the probe arms a
+faulthandler watchdog that dumps stacks and exits instead of hanging.
+
+Exit codes: 0 = TPU up (prints device), 1 = hung/init failed, 3 = resolved
+to a non-TPU platform.
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import sys
+import time
+
+
+def main(budget: float = 60.0) -> int:
+    faulthandler.dump_traceback_later(budget, exit=True)
+    import jax
+
+    t0 = time.perf_counter()
+    devices = jax.devices()
+    dt = time.perf_counter() - t0
+    print(f"platform={devices[0].platform} device={devices[0]} init_s={dt:.1f}")
+    if devices[0].platform != "tpu":
+        faulthandler.cancel_dump_traceback_later()
+        return 3
+    # one tiny computation proves the tunnel actually executes work; the
+    # watchdog stays armed — a tunnel can init fine yet hang on execution
+    faulthandler.cancel_dump_traceback_later()
+    faulthandler.dump_traceback_later(budget, exit=True)
+    x = jax.numpy.ones((128, 128))
+    print("matmul_ok", float((x @ x)[0, 0]))
+    faulthandler.cancel_dump_traceback_later()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(float(sys.argv[1]) if len(sys.argv) > 1 else 60.0))

@@ -9,6 +9,15 @@ model replica:
 - Chunked prefill interleaved with decode: each loop iteration runs at most
   one prefill chunk, then one decode step for all active slots — long
   prompts cannot starve in-flight decodes (SURVEY §7.3 hard part 3).
+- Pipelined decode (SURVEY §7.3 hard part 3, "low-latency token
+  streaming"): decode step N+1 is dispatched to the device BEFORE step N's
+  tokens are fetched, so the device never idles waiting for the host, and
+  every device→host fetch runs in a worker thread so the asyncio loop
+  (HTTP handlers, Kafka produces) never blocks on the chip. A sequence
+  that hits EOS at step N wastes one speculative token at N+1; the host
+  discards it. Grammar-constrained sequences need their host-side pick
+  written back before the next step, so pipelining pauses while one is in
+  flight (the tool-decision phase is short).
 - Per-sequence failure isolation (SURVEY §5.3): an errored sequence is
   evicted, its pages freed, an error event emitted on its stream, and the
   engine keeps serving the others. The process-level watchdog of the
@@ -38,6 +47,7 @@ from finchat_tpu.engine.sampler import SamplingParams
 from finchat_tpu.utils.faults import inject
 from finchat_tpu.utils.logging import get_logger
 from finchat_tpu.utils.metrics import METRICS
+from finchat_tpu.utils.tracing import RequestSpan
 
 logger = get_logger(__name__)
 
@@ -59,11 +69,28 @@ class SequenceHandle:
     submitted_at: float = field(default_factory=time.perf_counter)
     first_token_at: float | None = None
     finished: bool = False
+    span: RequestSpan = None  # type: ignore[assignment]  # set in __post_init__
+
+    def __post_init__(self) -> None:
+        if self.span is None:
+            self.span = RequestSpan(self.seq_id)
 
     def _emit_first_token_metrics(self) -> None:
         if self.first_token_at is None:
             self.first_token_at = time.perf_counter()
+            self.span.mark("first_token")
             METRICS.observe("finchat_ttft_seconds", self.first_token_at - self.submitted_at)
+
+
+@dataclass
+class _InFlightStep:
+    """A dispatched-but-unconsumed decode step (device arrays + the
+    membership snapshot it was dispatched against)."""
+
+    tokens: object  # [max_seqs] int32, device
+    logits: object | None  # [n_constrained, vocab] fp32 device slice, or None
+    members: list[tuple[int, SequenceHandle]]
+    constrained_slots: list[int]
 
 
 class ContinuousBatchingScheduler:
@@ -143,6 +170,7 @@ class ContinuousBatchingScheduler:
             pages = self.allocator.allocate(handle.seq_id, need)
             self.engine.set_page_table_row(slot, pages)
             handle.slot = slot
+            handle.span.mark("admitted")
             self._temperature[slot] = handle.sampling.temperature
             self._top_p[slot] = handle.sampling.top_p
             self._top_k[slot] = handle.sampling.top_k
@@ -152,6 +180,7 @@ class ContinuousBatchingScheduler:
 
     def _finish(self, handle: SequenceHandle, reason: str) -> None:
         handle.finished = True
+        handle.span.finish()
         handle.events.put_nowait({"type": "done", "reason": reason})
 
     def _release(self, handle: SequenceHandle) -> None:
@@ -170,11 +199,12 @@ class ContinuousBatchingScheduler:
         self._release(handle)
         if error is not None:
             handle.finished = True
+            handle.span.finish()
             handle.events.put_nowait({"type": "error", "message": error})
         else:
             self._finish(handle, reason)
 
-    def _prefill_one_chunk(self, handle: SequenceHandle) -> None:
+    async def _prefill_one_chunk(self, handle: SequenceHandle) -> None:
         inject("scheduler.prefill", seq_id=handle.seq_id)
         eng = self.engine
         C = eng.engine_cfg.prefill_chunk
@@ -188,22 +218,31 @@ class ContinuousBatchingScheduler:
             attn_backend=eng.attn_backend,
         )
         handle.prefill_pos += n_valid
-        if handle.prefill_pos >= len(handle.prompt_ids):
-            s = handle.sampling
-            eng.state, token = commit_first_token(
-                eng.state, jnp.int32(handle.slot), last_logits,
-                jnp.float32(s.temperature), jnp.float32(s.top_p), jnp.int32(s.top_k),
+        if handle.prefill_pos < len(handle.prompt_ids):
+            return  # more chunks to go; dispatch-only, no host sync needed
+        handle.span.mark("prefill_done")
+        s = handle.sampling
+        eng.state, token = commit_first_token(
+            eng.state, jnp.int32(handle.slot), last_logits,
+            jnp.float32(s.temperature), jnp.float32(s.top_p), jnp.int32(s.top_k),
+        )
+        if handle.constraint is not None:
+            logits_host = await asyncio.to_thread(np.asarray, last_logits)
+            if handle.finished:  # cancelled while fetching
+                return
+            token_id = handle.constraint.pick(
+                logits_host, s.temperature, self._rng,
+                remaining=s.max_new_tokens - handle.generated,
+                top_p=s.top_p, top_k=s.top_k,
             )
-            if handle.constraint is not None:
-                token = handle.constraint.pick(
-                    np.asarray(last_logits), s.temperature, self._rng,
-                    remaining=s.max_new_tokens - handle.generated,
-                    top_p=s.top_p, top_k=s.top_k,
-                )
-                eng.set_last_token(handle.slot, token)
-            self.prefilling.remove(handle)
-            self.decoding[handle.slot] = handle
-            self._deliver(handle, int(token))
+            eng.set_last_token(handle.slot, token_id)
+        else:
+            token_id = int(await asyncio.to_thread(np.asarray, token))
+            if handle.finished:
+                return
+        self.prefilling.remove(handle)
+        self.decoding[handle.slot] = handle
+        self._deliver(handle, int(token_id))
 
     def _deliver(self, handle: SequenceHandle, token_id: int) -> None:
         handle._emit_first_token_metrics()
@@ -217,7 +256,8 @@ class ContinuousBatchingScheduler:
         else:
             handle.events.put_nowait({"type": "token", "token_id": token_id})
 
-    def _decode_once(self) -> None:
+    def _dispatch_decode(self) -> _InFlightStep:
+        """Enqueue one decode step on the device; returns without syncing."""
         inject("scheduler.decode")
         eng = self.engine
         B = eng.engine_cfg.max_seqs
@@ -225,8 +265,13 @@ class ContinuousBatchingScheduler:
         for slot in self.decoding:
             active[slot] = True
         # step logits come back to host only while a grammar-constrained
-        # sequence is in flight (a second compiled decode variant)
-        need_logits = any(h.constraint is not None for h in self.decoding.values())
+        # sequence is in flight (a second compiled decode variant), and only
+        # the constrained rows are transferred — a [n, vocab] device slice,
+        # not the whole batch's [B, vocab].
+        constrained_slots = sorted(
+            slot for slot, h in self.decoding.items() if h.constraint is not None
+        )
+        need_logits = bool(constrained_slots)
         result = eng.decode(
             jnp.asarray(active),
             jnp.asarray(self._temperature),
@@ -235,12 +280,32 @@ class ContinuousBatchingScheduler:
             return_logits=need_logits,
         )
         next_tokens, logits = result if need_logits else (result, None)
-        tokens_host = np.asarray(next_tokens)
-        logits_host = np.asarray(logits) if logits is not None else None
-        for slot, handle in list(self.decoding.items()):
+        if logits is not None:
+            logits = logits[jnp.asarray(constrained_slots, jnp.int32)]
+        return _InFlightStep(
+            tokens=next_tokens, logits=logits,
+            members=list(self.decoding.items()),
+            constrained_slots=constrained_slots,
+        )
+
+    async def _consume_step(self, step: _InFlightStep) -> None:
+        """Fetch a dispatched step's tokens (in a worker thread, so the event
+        loop keeps serving) and deliver them to the sequences that were in
+        the batch when it was dispatched."""
+        tokens_host, logits_host = await asyncio.to_thread(
+            lambda: (
+                np.asarray(step.tokens),
+                np.asarray(step.logits) if step.logits is not None else None,
+            )
+        )
+        eng = self.engine
+        for slot, handle in step.members:
+            if handle.finished or handle.slot != slot:
+                continue  # evicted/cancelled since dispatch; token discarded
             if handle.constraint is not None and logits_host is not None:
                 token = handle.constraint.pick(
-                    logits_host[slot], handle.sampling.temperature, self._rng,
+                    logits_host[step.constrained_slots.index(slot)],
+                    handle.sampling.temperature, self._rng,
                     remaining=handle.sampling.max_new_tokens - handle.generated,
                     top_p=handle.sampling.top_p, top_k=handle.sampling.top_k,
                 )
@@ -252,8 +317,13 @@ class ContinuousBatchingScheduler:
 
     async def _loop(self) -> None:
         logger.info("scheduler loop started (max_seqs=%d)", self.engine.engine_cfg.max_seqs)
+        inflight: _InFlightStep | None = None
         while self._running:
             if not (self.pending or self.prefilling or self.decoding):
+                if inflight is not None:  # drain the pipeline before idling
+                    await self._consume_step(inflight)
+                    inflight = None
+                    continue
                 self._wakeup.clear()
                 try:
                     await asyncio.wait_for(self._wakeup.wait(), timeout=0.5)
@@ -268,20 +338,41 @@ class ContinuousBatchingScheduler:
             if self.prefilling:
                 handle = self.prefilling[0]
                 try:
-                    self._prefill_one_chunk(handle)
+                    await self._prefill_one_chunk(handle)
                 except Exception as e:  # per-sequence isolation
                     logger.error("prefill error for %s: %s", handle.seq_id, e)
                     self._evict(handle, "error", error=str(e))
 
             if self.decoding:
                 try:
-                    self._decode_once()
+                    constrained = any(
+                        h.constraint is not None for h in self.decoding.values()
+                    )
+                    if constrained:
+                        # host-side picks must land before the next dispatch:
+                        # run the pipeline depth-1 (dispatch → consume)
+                        if inflight is not None:
+                            await self._consume_step(inflight)
+                            inflight = None
+                        if self.decoding:
+                            await self._consume_step(self._dispatch_decode())
+                    else:
+                        # depth-2 pipeline: dispatch N+1, then consume N —
+                        # the device computes while the host delivers tokens
+                        step = self._dispatch_decode()
+                        if inflight is not None:
+                            await self._consume_step(inflight)
+                        inflight = step
                 except Exception as e:
                     # a whole-batch failure is not attributable to one
                     # sequence: fail all in-flight decodes, keep serving
                     logger.error("decode step error: %s", e)
+                    inflight = None
                     for handle in list(self.decoding.values()):
                         self._evict(handle, "error", error=str(e))
+            elif inflight is not None:
+                await self._consume_step(inflight)
+                inflight = None
 
             await asyncio.sleep(0)  # let producers/consumers run
         logger.info("scheduler loop stopped")

@@ -39,9 +39,9 @@ class RequestSpan:
         return self.marks.get("first_token")
 
     def finish(self, registry: MetricsRegistry = METRICS) -> None:
+        # TTFT is observed at first-token time by the scheduler (so the
+        # histogram is live mid-request); here only the total is recorded.
         self.mark("done")
-        if "first_token" in self.marks:
-            registry.observe("finchat_ttft_seconds", self.marks["first_token"])
         registry.observe("finchat_request_seconds", self.marks["done"])
         logger.debug(
             "span %s: %s",
