@@ -125,15 +125,78 @@ class LLMAgent:
 
     # --- prompt assembly -------------------------------------------------
     def _tool_prompt_text(self, state: AgentState) -> str:
-        system = f"The current date is {self.today()}.\n{self.tool_prompt}"
-        return render_chat(system, state.user_context, state.chat_history, state.user_query)
+        def build(s: AgentState) -> str:
+            system = f"The current date is {self.today()}.\n{self.tool_prompt}"
+            return render_chat(system, s.user_context, s.chat_history, s.user_query)
+
+        return self._fit_prompt(build, state, self.tool_generator, self.tool_sampling)
 
     def _response_prompt_text(self, state: AgentState) -> str:
-        context = f"{state.user_context}\n"
-        if state.retrieved_transactions:
-            context += "Retrieved Transaction Data:\n" + "\n".join(state.retrieved_transactions)
-        system = f"The current date is {self.today()}.\n\n{self.system_prompt}"
-        return render_chat(system, context, state.chat_history, state.user_query)
+        def build(s: AgentState) -> str:
+            context = f"{s.user_context}\n"
+            if s.retrieved_transactions:
+                context += "Retrieved Transaction Data:\n" + "\n".join(s.retrieved_transactions)
+            system = f"The current date is {self.today()}.\n\n{self.system_prompt}"
+            return render_chat(system, context, s.chat_history, s.user_query)
+
+        return self._fit_prompt(build, state, self.response_generator, self.response_sampling)
+
+    def _fit_prompt(
+        self,
+        build: Callable[[AgentState], str],
+        state: AgentState,
+        generator: TextGenerator,
+        sampling: SamplingParams,
+    ) -> str:
+        """Window the conversation so the rendered prompt fits the engine's
+        token budget (history windowing, VERDICT r1 task 7).
+
+        The reference stuffs unbounded history + up to 10k retrieved rows
+        into the prompt (llm_agent.py:234-236, qdrant_tool.py:145) and relies
+        on the external API to cope; the in-tree engine has a hard KV budget,
+        so degrade explicitly: drop oldest history turns first, then halve
+        the retrieved-transaction block. ``state`` is mutated so the later
+        response prompt sees the same (already-windowed) conversation.
+        Generators without budgets (e.g. StubGenerator) skip windowing.
+        """
+        budget_fn = getattr(generator, "prompt_budget", None)
+        count_fn = getattr(generator, "count_tokens", None)
+        text = build(state)
+        if budget_fn is None or count_fn is None:
+            return text
+        budget = budget_fn(sampling)
+        if count_fn(text) <= budget:
+            return text
+        # binary-search the max suffix of history that fits (O(log turns)
+        # full rebuilds instead of one per dropped turn)
+        history = list(state.chat_history)
+        dropped_turns = 0
+        if history:
+            lo, hi = 0, len(history)  # turns KEPT from the end; lo always fits-or-is-floor
+            while lo < hi:
+                mid = (lo + hi + 1) // 2
+                state.chat_history = history[len(history) - mid:]
+                if count_fn(build(state)) <= budget:
+                    lo = mid
+                else:
+                    hi = mid - 1
+            state.chat_history = history[len(history) - lo:] if lo else []
+            dropped_turns = len(history) - lo
+            text = build(state)
+        dropped_rows = 0
+        while state.retrieved_transactions and count_fn(text) > budget:
+            keep = len(state.retrieved_transactions) // 2
+            dropped_rows += len(state.retrieved_transactions) - keep
+            state.retrieved_transactions = state.retrieved_transactions[:keep]
+            text = build(state)
+        if dropped_turns or dropped_rows:
+            logger.warning(
+                "windowed prompt to fit %d-token budget: dropped %d history "
+                "turns, %d retrieved rows", budget, dropped_turns, dropped_rows,
+            )
+        # anything still over budget (huge system prompt / user query) is
+        # handled by the generator's token-level head+tail splice
+        return text
 
     # --- nodes -----------------------------------------------------------
     async def _decide_retrieval_node(self, state: AgentState) -> AgentState:

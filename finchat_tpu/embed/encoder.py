@@ -2,10 +2,14 @@
 
 Replaces the reference's OpenAI embeddings API call
 (``tools/qdrant_tool.py:28,137``) with an in-tree bidirectional encoder:
-token+position embeddings → post-LN transformer stack → masked mean pooling
-→ L2 normalization (the bge recipe). Queries are batched and padded to fixed
-buckets so the encoder is one compiled function per bucket (no recompiles
-per request), and upserts ride the same batched path.
+token+position embeddings → post-LN transformer stack → pooling (CLS for the
+bge recipe, masked mean as an option) → L2 normalization. Layer semantics
+match HuggingFace ``BertModel`` (biases everywhere, exact GELU, token-type
+row 0 folded into the position table) so real bge-base-en checkpoints load
+via ``checkpoints/bert_loader.py`` and reproduce HF outputs — see
+tests/test_bert_loader.py for the torch parity proof. Queries are batched
+and padded to fixed buckets so the encoder is one compiled function per
+bucket (no recompiles per request), and upserts ride the same batched path.
 """
 
 from __future__ import annotations
@@ -33,6 +37,7 @@ class BertConfig:
     max_position: int = 512
     norm_eps: float = 1e-12
     dtype: Any = jnp.bfloat16
+    pooling: str = "mean"  # "mean" | "cls" (bge uses CLS)
 
     @property
     def head_dim(self) -> int:
@@ -42,9 +47,11 @@ class BertConfig:
 EMBED_PRESETS: dict[str, BertConfig] = {
     # byte-vocab debug/bench encoder
     "bge-tiny": BertConfig(),
-    # bge-base-en architecture (BAAI/bge-base-en-v1.5 card): BERT-base
+    # bge-base-en architecture (BAAI/bge-base-en-v1.5 card): BERT-base,
+    # CLS pooling + L2 norm
     "bge-base-en": BertConfig(
-        vocab_size=30_522, dim=768, n_layers=12, n_heads=12, hidden_dim=3072, max_position=512
+        vocab_size=30_522, dim=768, n_layers=12, n_heads=12, hidden_dim=3072,
+        max_position=512, pooling="cls",
     ),
 }
 
@@ -64,11 +71,15 @@ def init_bert_params(config: BertConfig, key: Array) -> dict[str, Any]:
         "embed_ln_bias": jnp.zeros((D,), c.dtype),
         "layers": {
             "qkv": dense(keys[2], (L, D, 3 * D), D),
+            "qkv_bias": jnp.zeros((L, 3 * D), c.dtype),
             "attn_out": dense(keys[3], (L, D, D), D),
+            "attn_out_bias": jnp.zeros((L, D), c.dtype),
             "ln1_scale": jnp.ones((L, D), c.dtype),
             "ln1_bias": jnp.zeros((L, D), c.dtype),
             "mlp_in": dense(keys[4], (L, D, F), D),
+            "mlp_in_bias": jnp.zeros((L, F), c.dtype),
             "mlp_out": dense(keys[5], (L, F, D), F),
+            "mlp_out_bias": jnp.zeros((L, D), c.dtype),
             "ln2_scale": jnp.ones((L, D), c.dtype),
             "ln2_bias": jnp.zeros((L, D), c.dtype),
         },
@@ -100,25 +111,34 @@ def encode_batch(
     valid = (jnp.arange(S)[None, :] < lengths[:, None])  # [B, S]
 
     def body(x, layer):
-        qkv = x @ layer["qkv"]  # [B,S,3D]
+        qkv = x @ layer["qkv"] + layer["qkv_bias"]  # [B,S,3D]
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(B, S, c.n_heads, c.head_dim)
         k = k.reshape(B, S, c.n_heads, c.head_dim)
         v = v.reshape(B, S, c.n_heads, c.head_dim)
         attn = mha_reference(q, k, v, causal=False, kv_len=lengths)
         x = _layer_norm(
-            x + attn.reshape(B, S, -1) @ layer["attn_out"],
+            x + attn.reshape(B, S, -1) @ layer["attn_out"] + layer["attn_out_bias"],
             layer["ln1_scale"], layer["ln1_bias"], c.norm_eps,
         )
-        h = jax.nn.gelu((x @ layer["mlp_in"]).astype(jnp.float32)).astype(x.dtype)
-        x = _layer_norm(x + h @ layer["mlp_out"], layer["ln2_scale"], layer["ln2_bias"], c.norm_eps)
+        # exact (erf) GELU — what BERT/bge checkpoints were trained with
+        h = jax.nn.gelu(
+            (x @ layer["mlp_in"] + layer["mlp_in_bias"]).astype(jnp.float32),
+            approximate=False,
+        ).astype(x.dtype)
+        x = _layer_norm(
+            x + h @ layer["mlp_out"] + layer["mlp_out_bias"],
+            layer["ln2_scale"], layer["ln2_bias"], c.norm_eps,
+        )
         return x, None
 
     x, _ = jax.lax.scan(body, x, params["layers"])
 
-    # masked mean pool + L2 normalize, fp32
-    mask = valid[:, :, None].astype(jnp.float32)
-    pooled = (x.astype(jnp.float32) * mask).sum(axis=1) / jnp.maximum(mask.sum(axis=1), 1.0)
+    if c.pooling == "cls":
+        pooled = x[:, 0, :].astype(jnp.float32)
+    else:  # masked mean
+        mask = valid[:, :, None].astype(jnp.float32)
+        pooled = (x.astype(jnp.float32) * mask).sum(axis=1) / jnp.maximum(mask.sum(axis=1), 1.0)
     return pooled / jnp.maximum(jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9)
 
 
@@ -126,12 +146,18 @@ _BUCKETS = (32, 64, 128, 256, 512)
 
 
 class EmbeddingEncoder:
-    """Host-side wrapper: tokenize, bucket-pad, encode on device."""
+    """Host-side wrapper: tokenize, bucket-pad, encode on device.
 
-    def __init__(self, config: BertConfig, params: dict[str, Any], tokenizer: Tokenizer):
+    ``batch_size`` (EmbedConfig.batch_size) caps the rows per device call so
+    a 10k-row ingest doesn't materialize one giant activation tensor.
+    """
+
+    def __init__(self, config: BertConfig, params: dict[str, Any], tokenizer: Tokenizer,
+                 *, batch_size: int = 64):
         self.config = config
         self.params = params
         self.tokenizer = tokenizer
+        self.batch_size = batch_size
 
     @property
     def dim(self) -> int:
@@ -144,8 +170,15 @@ class EmbeddingEncoder:
         return min(_BUCKETS[-1], self.config.max_position)
 
     def embed_batch(self, texts: list[str]) -> np.ndarray:
-        """Embed texts → [n, dim] fp32 numpy (one device call per bucket)."""
-        ids = [self.tokenizer.encode(t)[: self.config.max_position] for t in texts]
+        """Embed texts → [n, dim] fp32 numpy (one device call per micro-batch)."""
+        out = np.empty((len(texts), self.dim), np.float32)
+        for lo in range(0, len(texts), self.batch_size):
+            out[lo : lo + self.batch_size] = self._embed_micro(texts[lo : lo + self.batch_size])
+        return out
+
+    def _embed_micro(self, texts: list[str]) -> np.ndarray:
+        encode = getattr(self.tokenizer, "encode_with_specials", self.tokenizer.encode)
+        ids = [encode(t)[: self.config.max_position] for t in texts]
         lengths = [max(1, len(i)) for i in ids]
         bucket = self._bucket(max(lengths))
         padded = np.zeros((len(ids), bucket), np.int32)

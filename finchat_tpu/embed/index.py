@@ -15,9 +15,12 @@ are ``must user_id == X`` plus optional ``metadata.date >= now - N days``
 
 from __future__ import annotations
 
+import json
+import os
 import threading
 from dataclasses import dataclass, field
 from functools import partial
+from pathlib import Path
 from typing import Any
 
 import jax
@@ -139,3 +142,61 @@ class DeviceVectorIndex:
                     break
                 out.append(self._points[int(i)])
             return out
+
+    # --- durability (VERDICT r1 task 5) ---------------------------------
+    # The reference's collection lives in an external, durable Qdrant
+    # (qdrant_tool.py:24-37); the on-device index persists to a local
+    # snapshot instead so retrieval is not empty-at-boot.
+
+    def save(self, path: str) -> None:
+        """Atomic snapshot: vectors as .npz, payloads as .jsonl sidecar."""
+        with self._lock:
+            n = self._count
+            base = Path(path)
+            base.parent.mkdir(parents=True, exist_ok=True)
+            # np.savez appends ".npz" unless the name already ends with it
+            tmp_vec = str(base) + ".tmp.npz"
+            np.savez_compressed(
+                tmp_vec,
+                vectors=self._host_vectors[:n],
+                dates=self._dates[:n],
+                alive=self._alive[:n],
+            )
+            tmp_pay = str(base) + ".jsonl.tmp"
+            with open(tmp_pay, "w") as f:
+                for p in self._points:
+                    f.write(json.dumps({"id": p.id, "payload": p.payload}) + "\n")
+            os.replace(tmp_vec, str(base) + ".npz")
+            os.replace(tmp_pay, str(base) + ".jsonl")
+        logger.info("vector index saved: %d points -> %s.{npz,jsonl}", n, path)
+
+    @classmethod
+    def load(cls, path: str, dim: int) -> "DeviceVectorIndex":
+        """Restore a snapshot; a missing snapshot yields an empty index."""
+        base = Path(path)
+        vec_file, pay_file = Path(str(base) + ".npz"), Path(str(base) + ".jsonl")
+        index = cls(dim=dim)
+        if not (vec_file.exists() and pay_file.exists()):
+            logger.info("no vector snapshot at %s; starting empty", path)
+            return index
+        data = np.load(vec_file)
+        vectors, dates, alive = data["vectors"], data["dates"], data["alive"]
+        with open(pay_file) as f:
+            records = [json.loads(line) for line in f]
+        if len(records) != len(vectors):
+            # a crash between the two os.replace calls in save() can tear
+            # the snapshot; fail with a clear message, not an IndexError
+            raise ValueError(
+                f"snapshot mismatch at {path}: {len(vectors)} vectors vs "
+                f"{len(records)} payloads (torn snapshot?)"
+            )
+        points = [
+            VectorPoint(id=rec["id"], vector=vectors[row], payload=rec["payload"])
+            for row, rec in enumerate(records)
+        ]
+        index.upsert(points)
+        # restore tombstones + original dates exactly
+        index._alive[: len(points)] = alive
+        index._dates[: len(points)] = dates
+        logger.info("vector index restored: %d points from %s", len(points), path)
+        return index

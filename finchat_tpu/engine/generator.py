@@ -40,6 +40,21 @@ class EngineGenerator:
         self._ids = itertools.count()
         self._grammar_vocabs: dict[str, object] = {}  # grammar name -> GrammarVocab
 
+    # --- prompt budgeting (SURVEY §5.7; VERDICT r1 task 7) ---------------
+    # The agent uses these to window history BEFORE submit, so over-long
+    # conversations degrade gracefully instead of erroring at the scheduler
+    # (the reference stuffs unbounded history, llm_agent.py:234-236, and
+    # leans on the external API as backstop; here the budget is explicit).
+    def count_tokens(self, text: str) -> int:
+        return len(self.tokenizer.encode(text, add_bos=True))
+
+    def prompt_budget(self, sampling: SamplingParams) -> int:
+        """Max prompt tokens a sequence may carry and still have room for
+        ``max_new_tokens`` in its KV allocation."""
+        eng = self.scheduler.engine
+        max_len = eng.max_pages_per_seq * eng.page_size
+        return max(1, max_len - sampling.max_new_tokens)
+
     async def _make_constraint(self, grammar: str):
         from finchat_tpu.agent.constrained import GrammarVocab, TokenConstraint
 
@@ -66,6 +81,19 @@ class EngineGenerator:
 
     async def stream(self, prompt: str, sampling: SamplingParams) -> AsyncIterator[str]:
         prompt_ids = self.tokenizer.encode(prompt, add_bos=True)
+        budget = self.prompt_budget(sampling)
+        if len(prompt_ids) > budget:
+            # token-level backstop beneath the agent's structural windowing:
+            # keep the head (system rules) and the tail (latest turns + open
+            # assistant tag) and drop the middle, so a too-long prompt still
+            # answers instead of raising at submit
+            head = budget // 4
+            tail = budget - head
+            logger.warning(
+                "prompt of %d tokens exceeds budget %d; splicing head %d + tail %d",
+                len(prompt_ids), budget, head, tail,
+            )
+            prompt_ids = prompt_ids[:head] + prompt_ids[-tail:]
         seq_id = f"seq-{next(self._ids)}"
         constraint = await self._make_constraint(sampling.grammar) if sampling.grammar else None
         handle = await self.scheduler.submit(seq_id, prompt_ids, sampling, constraint=constraint)

@@ -48,6 +48,10 @@ class ByteTokenizer:
         ids = list(text.encode("utf-8"))
         return ([self.bos_id] if add_bos else []) + ids
 
+    def encode_with_specials(self, text: str) -> list[int]:
+        """Encoder-style framing (the embedding path's [CLS]...[SEP])."""
+        return [self.bos_id] + self.encode(text) + [self.eos_id]
+
     def decode(self, ids: Sequence[int]) -> str:
         return bytes(i for i in ids if i < 256).decode("utf-8", errors="replace")
 
@@ -68,6 +72,11 @@ class HFTokenizer:
     def encode(self, text: str, add_bos: bool = False) -> list[int]:
         ids = self._tok.encode(text, add_special_tokens=False)
         return ([self.bos_id] if add_bos else []) + ids
+
+    def encode_with_specials(self, text: str) -> list[int]:
+        """The tokenizer's own special framing — [CLS]...[SEP] for BERT
+        vocabularies (what bge embeddings expect), <s>... for Llama ones."""
+        return self._tok.encode(text, add_special_tokens=True)
 
     def decode(self, ids: Sequence[int]) -> str:
         return self._tok.decode(ids, skip_special_tokens=True)

@@ -2,6 +2,9 @@
 
 Axis convention (scaling-book style):
 - ``data``   — batch/DP; gradients all-reduce here.
+- ``pipe``   — pipeline parallelism; stages exchange activations point-to-
+  point (axis exposed per SURVEY §2.3, size 1 today — no stage scheduler
+  yet, so 70B-scale configs aren't boxed out of the mesh shape).
 - ``model``  — tensor parallelism; attention heads + MLP hidden sharded.
 - ``seq``    — sequence/context parallelism (ring attention rides this).
 - ``expert`` — expert parallelism (MoE models; axis exposed, size 1 today).
@@ -9,7 +12,9 @@ Axis convention (scaling-book style):
 ICI/DCN note: axis ORDER matters on real slices — ``jax.make_mesh`` puts the
 fastest-varying (last) axis on the innermost ICI ring, so ``model`` (the
 chattiest: 2 all-reduces/layer) is last; ``data`` (one gradient reduce per
-step, DCN-tolerant) is first and lands across slices/hosts.
+step, DCN-tolerant) is first and lands across slices/hosts; ``pipe`` sits
+right after ``data`` (stage hops are infrequent point-to-point sends and
+tolerate DCN).
 
 Multi-host: call ``initialize_distributed()`` once per process before
 building the mesh; jax then sees the global device set.
@@ -27,22 +32,24 @@ from finchat_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
-AXES = ("data", "seq", "expert", "model")
+AXES = ("data", "pipe", "seq", "expert", "model")
 
 
 @dataclass(frozen=True)
 class MeshSpec:
     data: int = 1
+    pipe: int = 1
     seq: int = 1
     expert: int = 1
     model: int = -1  # -1 = absorb all remaining devices
 
     @classmethod
     def from_config(cls, cfg: MeshConfig) -> "MeshSpec":
-        return cls(data=cfg.data, seq=cfg.seq, expert=cfg.expert, model=cfg.model)
+        return cls(data=cfg.data, pipe=cfg.pipe, seq=cfg.seq,
+                   expert=cfg.expert, model=cfg.model)
 
-    def resolve(self, n_devices: int) -> tuple[int, int, int, int]:
-        sizes = [self.data, self.seq, self.expert, self.model]
+    def resolve(self, n_devices: int) -> tuple[int, ...]:
+        sizes = [self.data, self.pipe, self.seq, self.expert, self.model]
         free = [i for i, s in enumerate(sizes) if s == -1]
         fixed = 1
         for s in sizes:
@@ -54,10 +61,12 @@ class MeshSpec:
             if n_devices % fixed:
                 raise ValueError(f"{n_devices} devices not divisible by fixed axes {fixed}")
             sizes[free[0]] = n_devices // fixed
-        total = sizes[0] * sizes[1] * sizes[2] * sizes[3]
+        total = 1
+        for s in sizes:
+            total *= s
         if total != n_devices:
             raise ValueError(f"mesh {dict(zip(AXES, sizes))} needs {total} devices, have {n_devices}")
-        return tuple(sizes)  # type: ignore[return-value]
+        return tuple(sizes)
 
 
 def build_mesh(spec: MeshSpec | None = None, devices=None) -> Mesh:

@@ -17,6 +17,11 @@ Behavior parity with the reference ``main.py``:
   (status/retrieval_complete/response_chunk/complete), the "richer consumer"
   SURVEY §2.4 calls for.
 - ``GET /metrics`` — Prometheus text (new; SURVEY §5.5).
+- Transaction ingestion (new; the reference's upsert pipeline lives outside
+  its repo, feeding Qdrant out-of-band — qdrant_tool.py:24-37): both
+  ``POST /transactions`` and the ``transaction_upsert`` Kafka topic embed
+  rows on-device into the vector index, which snapshots to
+  ``vector.persist_path`` so retrieval is not empty-at-boot.
 """
 
 from __future__ import annotations
@@ -45,7 +50,12 @@ from finchat_tpu.models.llama import PRESETS, init_params
 from finchat_tpu.models.tokenizer import get_tokenizer
 from finchat_tpu.serve.http import HTTPServer, Request, Response, StreamingResponse, sse_event
 from finchat_tpu.tools.retrieval import TransactionRetriever
-from finchat_tpu.utils.config import AI_RESPONSE_TOPIC, AppConfig
+from finchat_tpu.utils.config import (
+    AI_RESPONSE_TOPIC,
+    TRANSACTION_UPSERT_TOPIC,
+    USER_MESSAGE_TOPIC,
+    AppConfig,
+)
 from finchat_tpu.utils.logging import get_logger
 from finchat_tpu.utils.metrics import METRICS
 
@@ -72,6 +82,12 @@ def build_generators(cfg: AppConfig) -> tuple[TextGenerator, TextGenerator, Cont
         return stub, stub, None, get_tokenizer()
 
     config = PRESETS[cfg.model.preset]
+    if cfg.model.dtype:
+        import dataclasses
+
+        import jax.numpy as jnp
+
+        config = dataclasses.replace(config, dtype=getattr(jnp, cfg.model.dtype))
     tokenizer = get_tokenizer(cfg.model.tokenizer_path)
     if cfg.model.checkpoint_path:
         from finchat_tpu.checkpoints.hf_loader import load_llama_params
@@ -83,7 +99,7 @@ def build_generators(cfg: AppConfig) -> tuple[TextGenerator, TextGenerator, Cont
     from finchat_tpu.parallel.mesh import MeshSpec, build_mesh
 
     spec = MeshSpec.from_config(cfg.mesh)
-    sizes = (spec.data, spec.seq, spec.expert, spec.model)
+    sizes = (spec.data, spec.pipe, spec.seq, spec.expert, spec.model)
     fixed = 1
     for s in sizes:
         if s != -1:
@@ -103,24 +119,30 @@ class App:
     """One worker process: HTTP surface + Kafka consume loop + engine."""
 
     def __init__(self, cfg: AppConfig, *, agent: LLMAgent, store: ConversationStore,
-                 kafka: KafkaClient, scheduler: ContinuousBatchingScheduler | None = None):
+                 kafka: KafkaClient, scheduler: ContinuousBatchingScheduler | None = None,
+                 retriever: TransactionRetriever | None = None):
         self.cfg = cfg
         self.agent = agent
         self.store = store
         self.kafka = kafka
         self.scheduler = scheduler
+        self.retriever = retriever
         self.server = HTTPServer(cfg.serve.host, cfg.serve.port)
         self.server.route("GET", "/health", self.health)
         self.server.route("GET", "/metrics", self.metrics)
         self.server.route("POST", "/chat", self.chat)
         self.server.route("POST", "/chat/stream", self.chat_stream)
+        self.server.route("POST", "/transactions", self.upsert_transactions)
         self._consume_task: asyncio.Task | None = None
         self._running = False
 
     # --- lifespan -------------------------------------------------------
     async def start(self, serve_http: bool = True) -> None:
         await self.store.check_connection()
-        self.kafka.setup_consumer()
+        topics = [USER_MESSAGE_TOPIC]
+        if self.retriever is not None:
+            topics.append(TRANSACTION_UPSERT_TOPIC)
+        self.kafka.setup_consumer(topics=topics)
         if self.scheduler is not None:
             await self.scheduler.start()
         self._running = True
@@ -138,8 +160,30 @@ class App:
                 pass
         if self.scheduler is not None:
             await self.scheduler.stop()
+        self._persist_index(force=True)
         await self.server.stop()
         self.kafka.close()
+
+    # snapshots are full rewrites (np.savez over the whole collection), so
+    # debounce streaming-ingest saves; shutdown always forces one
+    _PERSIST_DEBOUNCE_S = 30.0
+
+    def _persist_index(self, force: bool = False) -> None:
+        base = self.cfg.vector.snapshot_base()
+        if not base or self.retriever is None:
+            return
+        import time as _time
+
+        now = _time.monotonic()
+        if not force and now - getattr(self, "_last_persist", 0.0) < self._PERSIST_DEBOUNCE_S:
+            self._persist_dirty = True
+            return
+        try:
+            self.retriever.index.save(base)
+            self._last_persist = now
+            self._persist_dirty = False
+        except Exception as e:
+            logger.error("failed to persist vector index: %s", e)
 
     # --- HTTP handlers --------------------------------------------------
     async def health(self, request: Request) -> Response:
@@ -183,6 +227,46 @@ class App:
 
         return StreamingResponse(chunks=events())
 
+    async def upsert_transactions(self, request: Request) -> Response:
+        """Ingestion endpoint: embed rows on-device and upsert them into the
+        vector index (the reference's out-of-band Qdrant pipeline made
+        first-class). Body: {"user_id": ..., "transactions":
+        [{"text": ..., "date"?: unix-ts, ...metadata}]}."""
+        if self.retriever is None:
+            return Response.json({"detail": "no retriever configured"}, status=503)
+        payload = request.json()
+        missing = [k for k in ("user_id", "transactions") if k not in payload]
+        if missing:
+            return Response.json({"detail": f"missing fields: {missing}"}, status=400)
+        rows = payload["transactions"]
+        if not isinstance(rows, list) or not all(
+            isinstance(r, dict) and r.get("text") for r in rows
+        ):
+            return Response.json(
+                {"detail": "transactions must be [{text, date?, ...metadata}]"}, status=400
+            )
+        try:
+            count = await asyncio.to_thread(
+                self._ingest_rows, str(payload["user_id"]), rows
+            )
+        except (TypeError, ValueError) as e:
+            return Response.json({"detail": f"bad transaction row: {e}"}, status=400)
+        return Response.json({"upserted": count})
+
+    def _ingest_rows(self, user_id: str, rows: list[dict]) -> int:
+        """Embed + upsert (blocking: device matmuls); callers thread it off
+        the loop. Rows without a ``date`` are stamped individually with now
+        (a malformed date raises ValueError → 400 at the handler)."""
+        texts = [str(r["text"]) for r in rows]
+        now = self.retriever.now()
+        dates = [float(r["date"]) if "date" in r else now for r in rows]
+        metadatas = [
+            {k: v for k, v in r.items() if k not in ("text", "date")} for r in rows
+        ]
+        self.retriever.upsert_transactions(user_id, texts, dates=dates, metadatas=metadatas)
+        self._persist_index()
+        return len(texts)
+
     # --- Kafka worker loop ----------------------------------------------
     async def process_message(self, message) -> None:
         message_value = json.loads(message.value().decode("utf-8"))
@@ -198,21 +282,36 @@ class App:
             logger.error("Error retrieving context or history for conversation %s: %s", conversation_id, e)
             return
 
+        # stream_flush_tokens > 1 coalesces N model chunks into one outbound
+        # Kafka produce — fewer, larger messages for high-throughput topics
+        # (1 = reference behavior: one produce per chunk, main.py:86-96)
+        flush_every = max(1, self.cfg.engine.stream_flush_tokens)
+        pending_chunks: list[str] = []
+
+        def flush_pending() -> None:
+            if pending_chunks:
+                text = "".join(pending_chunks)
+                pending_chunks.clear()
+                self.kafka.produce_message(
+                    AI_RESPONSE_TOPIC, conversation_id, response_chunk(message_value, text)
+                )
+                logger.debug("Processed chunk: %s", text)
+
         try:
             async for update in self.agent.stream_with_status(msg, user_id, context, chat_history):
                 if update["type"] == "response_chunk":
                     chunk_text = update["content"]
                     full_message += chunk_text
-                    self.kafka.produce_message(
-                        AI_RESPONSE_TOPIC, conversation_id, response_chunk(message_value, chunk_text)
-                    )
-                    logger.debug("Processed chunk: %s", chunk_text)
+                    pending_chunks.append(chunk_text)
+                    if len(pending_chunks) >= flush_every:
+                        flush_pending()
                 elif update["type"] == "plot":
                     # NEW capability (additive chunk type; schemas.plot_chunk)
                     self.kafka.produce_message(
                         AI_RESPONSE_TOPIC, conversation_id, plot_chunk(message_value, update["data_uri"])
                     )
                 elif update["type"] == "complete":
+                    flush_pending()  # never reorder text after the marker
                     self.kafka.produce_message(
                         AI_RESPONSE_TOPIC, conversation_id, complete_chunk(message_value)
                     )
@@ -222,6 +321,12 @@ class App:
                 # complete; plot is the one additive extension)
         except Exception as e:
             logger.error("Error streaming LLM response: %s", e)
+            # best-effort: text the client was owed goes out before the
+            # error marker (at flush=1 this is reference behavior exactly)
+            try:
+                flush_pending()
+            except Exception:
+                pass
             self.kafka.produce_error_message(
                 AI_RESPONSE_TOPIC, conversation_id, error_chunk(message_value)
             )
@@ -233,12 +338,28 @@ class App:
         except Exception as e:
             logger.error("Error saving AI message to DB: %s", e)
 
+    async def process_upsert(self, message) -> None:
+        """transaction_upsert topic: same body as POST /transactions."""
+        payload = json.loads(message.value().decode("utf-8"))
+        rows = payload.get("transactions") or []
+        user_id = str(payload.get("user_id", ""))
+        if not user_id or not all(isinstance(r, dict) and r.get("text") for r in rows):
+            logger.error("malformed transaction_upsert message; dropped")
+            return
+        count = await asyncio.to_thread(self._ingest_rows, user_id, rows)
+        logger.info("ingested %d transactions for user %s via Kafka", count, user_id)
+
     async def consume_messages(self) -> None:
         watchdog = self.cfg.engine.watchdog_seconds
         while self._running:
             try:
                 msg = self.kafka.poll_message()
-                if msg is not None:
+                if msg is not None and msg.topic() == TRANSACTION_UPSERT_TOPIC:
+                    try:
+                        await self.process_upsert(msg)
+                    except Exception as e:
+                        logger.error("Error ingesting transactions: %s", e)
+                elif msg is not None:
                     try:
                         await asyncio.wait_for(self.process_message(msg), timeout=watchdog)
                     except asyncio.TimeoutError:
@@ -253,6 +374,9 @@ class App:
                         except Exception as e:
                             logger.error("Failed to send timeout error message: %s", e)
                 else:
+                    # deferred snapshot from a debounced ingest save
+                    if getattr(self, "_persist_dirty", False):
+                        self._persist_index()
                     await asyncio.sleep(0.01)
             except asyncio.CancelledError:
                 raise
@@ -284,11 +408,48 @@ def build_app(cfg: AppConfig | None = None, *, store: ConversationStore | None =
         from finchat_tpu.embed.encoder import EMBED_PRESETS, EmbeddingEncoder, init_bert_params
         from finchat_tpu.embed.index import DeviceVectorIndex
 
+        if cfg.vector.url or cfg.vector.api_key:
+            # QDRANT_URL/QDRANT_API_KEY accepted for reference .env drop-in
+            # compatibility; no external qdrant client ships in-tree, the
+            # on-device index (with local snapshots) is the vector backend.
+            logger.warning(
+                "QDRANT_URL/QDRANT_API_KEY set (%s) but the external qdrant "
+                "backend is not bundled; using the on-device vector index",
+                cfg.vector.url,
+            )
         embed_cfg = EMBED_PRESETS[cfg.embed.preset]
-        embed_params = init_bert_params(embed_cfg, jax.random.key(1))
-        encoder = EmbeddingEncoder(embed_cfg, embed_params, tokenizer or get_tokenizer())
-        index = DeviceVectorIndex(dim=embed_cfg.dim)
-        retriever = TransactionRetriever(encoder, index)
+        if cfg.embed.checkpoint_path:
+            from finchat_tpu.checkpoints.bert_loader import load_bert_params
+
+            embed_params = load_bert_params(cfg.embed.checkpoint_path, embed_cfg)
+        else:
+            logger.warning(
+                "no embedding checkpoint configured; using RANDOM weights "
+                "(preset=%s) — retrieval rankings will be meaningless", cfg.embed.preset,
+            )
+            embed_params = init_bert_params(embed_cfg, jax.random.key(1))
+        if cfg.embed.tokenizer_path:
+            embed_tokenizer = get_tokenizer(cfg.embed.tokenizer_path)
+        else:
+            if cfg.embed.checkpoint_path:
+                logger.warning(
+                    "embed.checkpoint_path is set but embed.tokenizer_path is "
+                    "not; falling back to the LLM/byte tokenizer, whose ids "
+                    "will NOT match the BERT vocab — retrieval rankings will "
+                    "be meaningless. Set FINCHAT_EMBED_TOKENIZER."
+                )
+            embed_tokenizer = tokenizer or get_tokenizer()
+        encoder = EmbeddingEncoder(
+            embed_cfg, embed_params, embed_tokenizer, batch_size=cfg.embed.batch_size
+        )
+        base = cfg.vector.snapshot_base()
+        if base:
+            index = DeviceVectorIndex.load(base, dim=embed_cfg.dim)
+        else:
+            index = DeviceVectorIndex(dim=embed_cfg.dim)
+        retriever = TransactionRetriever(
+            encoder, index, default_limit=cfg.vector.default_limit
+        )
 
     system_prompt, tool_prompt = load_prompts()
     agent = LLMAgent(
@@ -298,4 +459,6 @@ def build_app(cfg: AppConfig | None = None, *, store: ConversationStore | None =
             top_k=cfg.engine.top_k, max_new_tokens=cfg.engine.max_new_tokens,
         ),
     )
-    return App(cfg, agent=agent, store=store, kafka=kafka, scheduler=scheduler)
+    app_retriever = retriever if isinstance(retriever, TransactionRetriever) else None
+    return App(cfg, agent=agent, store=store, kafka=kafka, scheduler=scheduler,
+               retriever=app_retriever)

@@ -38,10 +38,12 @@ class TransactionRetriever:
         encoder: EmbeddingEncoder,
         index: DeviceVectorIndex,
         *,
+        default_limit: int = DEFAULT_LIMIT,  # VectorConfig.default_limit
         now: Callable[[], float] = time.time,
     ):
         self.encoder = encoder
         self.index = index
+        self.default_limit = default_limit
         self.now = now
 
     async def __call__(self, args: dict[str, Any]) -> list[str]:
@@ -59,7 +61,7 @@ class TransactionRetriever:
                 return []
 
             search_query = args.get("search_query") or DEFAULT_QUERY
-            limit = args.get("num_transactions") or DEFAULT_LIMIT
+            limit = args.get("num_transactions") or self.default_limit
             date_gte = None
             days = args.get("time_period_days")
             if days:

@@ -26,6 +26,9 @@ from typing import Any
 # ---------------------------------------------------------------------------
 USER_MESSAGE_TOPIC = "user_message"
 AI_RESPONSE_TOPIC = "ai_response"
+# NEW topic (no reference counterpart): transaction rows for vector-index
+# ingestion — the reference's upsert pipeline lives outside its repo.
+TRANSACTION_UPSERT_TOPIC = "transaction_upsert"
 GROUP_ID = "message_consumer"
 CONTEXT_COLLECTION_NAME = "contexts"
 MESSAGE_COLLECTION_NAME = "messages"
@@ -89,17 +92,29 @@ class VectorConfig:
     """Vector index over user transactions.
 
     The reference delegates to a remote Qdrant (``tools/qdrant_tool.py``);
-    here the default backend is the in-tree on-device index with brute-force
-    exact cosine search on the MXU. ``hnsw_ef`` is kept for the optional
-    qdrant backend's parity (reference qdrant_tool.py:99).
+    here the backend is the in-tree on-device index (brute-force exact
+    cosine on the MXU) with a local durable snapshot (``persist_path``).
+    ``url``/``api_key`` keep the reference's ``QDRANT_URL``/``QDRANT_API_KEY``
+    env names working for .env drop-in compatibility; since no external
+    qdrant client ships in-tree, a configured url is logged-and-ignored at
+    boot (serve/app.py) rather than silently dropped.
     """
 
     url: str = ""
     api_key: str = ""
     collection: str = TRANSACTION_COLLECTION_NAME
-    hnsw_ef: int = 128
     default_limit: int = 10_000  # reference qdrant_tool.py:145
-    backend: str = "device"  # "device" | "qdrant"
+    persist_path: str = ""  # snapshot directory; empty = in-memory only
+
+    def snapshot_base(self) -> str:
+        """Snapshot file base: ``<persist_path>/<collection>`` — the
+        collection name keys the on-disk layout the way it keys the
+        reference's Qdrant collection (config.py:47)."""
+        if not self.persist_path:
+            return ""
+        import pathlib
+
+        return str(pathlib.Path(self.persist_path) / self.collection)
 
 
 @dataclass
@@ -118,11 +133,12 @@ class MeshConfig:
     """Device mesh axes (no reference counterpart — reference has no devices).
 
     Axis names follow the scaling-book convention: ``data`` (DP/batch),
-    ``model`` (TP), ``seq`` (SP/ring attention), ``expert`` (EP). A size of
-    -1 means "absorb all remaining devices".
+    ``pipe`` (PP stages), ``model`` (TP), ``seq`` (SP/ring attention),
+    ``expert`` (EP). A size of -1 means "absorb all remaining devices".
     """
 
     data: int = 1
+    pipe: int = 1
     model: int = -1
     seq: int = 1
     expert: int = 1
@@ -147,12 +163,18 @@ class EngineConfig:
 
 @dataclass
 class EmbedConfig:
-    """TPU embedding encoder (replaces OpenAI embeddings API)."""
+    """TPU embedding encoder (replaces OpenAI embeddings API).
 
-    preset: str = "bge-tiny"  # see models/bert.py PRESETS
+    ``checkpoint_path``: HF BertModel safetensors dir (e.g. bge-base-en-v1.5)
+    loaded via checkpoints/bert_loader.py; empty = random weights (dev only).
+    ``tokenizer_path``: matching HF tokenizer dir; empty = byte tokenizer.
+    ``batch_size``: rows per device call during batch embedding/ingest.
+    """
+
+    preset: str = "bge-tiny"  # see embed/encoder.py EMBED_PRESETS
     checkpoint_path: str = ""
+    tokenizer_path: str = ""
     batch_size: int = 64
-    dim: int = 384
 
 
 @dataclass
@@ -215,10 +237,12 @@ def load_config(
     # --- env (new framework surface) ---
     cfg.kafka.backend = _env("FINCHAT_KAFKA_BACKEND", cfg.kafka.backend)
     cfg.store.backend = _env("FINCHAT_STORE_BACKEND", cfg.store.backend)
-    cfg.vector.backend = _env("FINCHAT_VECTOR_BACKEND", cfg.vector.backend)
+    cfg.vector.persist_path = _env("FINCHAT_VECTOR_PERSIST", cfg.vector.persist_path)
     cfg.model.preset = _env("FINCHAT_MODEL_PRESET", cfg.model.preset)
     cfg.model.checkpoint_path = _env("FINCHAT_CHECKPOINT", cfg.model.checkpoint_path)
     cfg.model.tokenizer_path = _env("FINCHAT_TOKENIZER", cfg.model.tokenizer_path)
+    cfg.embed.checkpoint_path = _env("FINCHAT_EMBED_CHECKPOINT", cfg.embed.checkpoint_path)
+    cfg.embed.tokenizer_path = _env("FINCHAT_EMBED_TOKENIZER", cfg.embed.tokenizer_path)
     cfg.engine.max_seqs = _env_int("FINCHAT_MAX_SEQS", cfg.engine.max_seqs)
     cfg.serve.port = _env_int("FINCHAT_PORT", cfg.serve.port)
 

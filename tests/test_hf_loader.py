@@ -1,0 +1,187 @@
+"""Real-checkpoint correctness (SURVEY §4.5, VERDICT r1 task 3).
+
+A tiny HF-format Llama checkpoint (config.json + model.safetensors) is
+written by torch/transformers, loaded through ``load_llama_params``, and the
+jax stack is checked against the INDEPENDENT torch implementation:
+
+- pytree layout (transpose/stack) equals hand-stacked expectations;
+- full-sequence logits match transformers' LlamaForCausalLM in fp32;
+- greedy decode through the paged InferenceEngine (chunked prefill + paged
+  decode) reproduces torch's greedy continuation exactly — the golden
+  token-id test;
+- the tied-embedding branch (TinyLlama/Llama-3.2 style, hf_loader.py) and
+  the config cross-check both behave.
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from safetensors.numpy import save_file  # noqa: E402
+
+from finchat_tpu.checkpoints.hf_loader import load_llama_params  # noqa: E402
+from finchat_tpu.models.llama import LlamaConfig, forward_full  # noqa: E402
+
+HF_CFG = dict(
+    vocab_size=128,
+    hidden_size=64,
+    num_hidden_layers=2,
+    num_attention_heads=4,
+    num_key_value_heads=2,
+    intermediate_size=96,
+    max_position_embeddings=256,
+    rope_theta=10_000.0,
+    rms_norm_eps=1e-5,
+)
+
+OUR_CFG = LlamaConfig(
+    vocab_size=128, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+    hidden_dim=96, rope_theta=10_000.0, norm_eps=1e-5, max_seq_len=256,
+    dtype=jnp.float32,
+)
+
+
+def _write_checkpoint(path, tied: bool):
+    """Build a seeded torch Llama and save it in HF checkpoint format."""
+    from transformers import LlamaConfig as HFLlamaConfig
+    from transformers import LlamaForCausalLM
+
+    torch.manual_seed(7 if tied else 11)
+    model = LlamaForCausalLM(
+        HFLlamaConfig(**HF_CFG, tie_word_embeddings=tied, attn_implementation="eager")
+    )
+    model.eval()
+    tensors = {
+        k: v.detach().to(torch.float32).numpy().copy()
+        for k, v in model.state_dict().items()
+    }
+    if tied:
+        # tied checkpoints ship without lm_head (hf_loader.py handles it)
+        tensors.pop("lm_head.weight", None)
+    save_file(tensors, str(path / "model.safetensors"))
+    (path / "config.json").write_text(
+        json.dumps({**HF_CFG, "model_type": "llama",
+                    "architectures": ["LlamaForCausalLM"],
+                    "tie_word_embeddings": tied})
+    )
+    return model, tensors
+
+
+@pytest.fixture(scope="module")
+def checkpoint(tmp_path_factory):
+    path = tmp_path_factory.mktemp("hf_ckpt")
+    model, tensors = _write_checkpoint(path, tied=False)
+    return path, model, tensors
+
+
+def test_loader_layout_matches_hand_stacking(checkpoint):
+    path, _, tensors = checkpoint
+    params = load_llama_params(str(path), OUR_CFG)
+
+    np.testing.assert_array_equal(
+        np.asarray(params["embed"]), tensors["model.embed_tokens.weight"]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(params["lm_head"]), tensors["lm_head.weight"].T
+    )
+    expect_q = np.stack([
+        tensors[f"model.layers.{i}.self_attn.q_proj.weight"].T for i in range(2)
+    ])
+    np.testing.assert_array_equal(np.asarray(params["layers"]["attn_q"]), expect_q)
+    expect_ln = np.stack([
+        tensors[f"model.layers.{i}.input_layernorm.weight"] for i in range(2)
+    ])
+    np.testing.assert_array_equal(np.asarray(params["layers"]["ln_attn"]), expect_ln)
+
+
+def test_logits_parity_with_transformers(checkpoint):
+    path, model, _ = checkpoint
+    params = load_llama_params(str(path), OUR_CFG)
+
+    ids = np.array([[1, 5, 9, 42, 99, 17, 3, 64]], np.int32)
+    with torch.no_grad():
+        ref = model(torch.from_numpy(ids.astype(np.int64))).logits.numpy()
+
+    positions = np.arange(ids.shape[1], dtype=np.int32)[None, :]
+    ours = np.asarray(
+        forward_full(params, jnp.asarray(ids), jnp.asarray(positions),
+                     config=OUR_CFG, attn_backend="ref")
+    )
+    np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-3)
+
+
+def test_golden_greedy_decode_through_paged_engine(checkpoint):
+    """Greedy continuation through chunked prefill + paged decode equals
+    torch's greedy loop token-for-token (exact ids, SURVEY §4.5)."""
+    import jax
+
+    from finchat_tpu.engine.engine import InferenceEngine
+    from finchat_tpu.utils.config import EngineConfig
+
+    path, model, _ = checkpoint
+    params = load_llama_params(str(path), OUR_CFG)
+
+    prompt = [1, 5, 9, 42, 99]
+    n_new = 12
+
+    # torch golden: greedy argmax loop
+    golden = []
+    ids = torch.tensor([prompt], dtype=torch.long)
+    with torch.no_grad():
+        for _ in range(n_new):
+            nxt = int(model(ids).logits[0, -1].argmax())
+            golden.append(nxt)
+            ids = torch.cat([ids, torch.tensor([[nxt]])], dim=1)
+
+    engine_cfg = EngineConfig(
+        max_seqs=2, page_size=8, num_pages=32, max_seq_len=64, prefill_chunk=4
+    )
+    engine = InferenceEngine(OUR_CFG, params, engine_cfg, attn_backend="ref")
+    engine.set_page_table_row(0, list(range(1, 9)))
+    logits = engine.prefill(0, prompt)
+    first = int(jnp.argmax(logits))
+    engine.set_last_token(0, first)
+    got = [first]
+    active = jnp.asarray([True, False])
+    zeros = jnp.zeros((2,), jnp.float32)
+    topk = jnp.zeros((2,), jnp.int32)
+    for _ in range(n_new - 1):
+        toks = engine.decode(active, zeros, jnp.ones((2,), jnp.float32), topk)
+        got.append(int(np.asarray(toks)[0]))
+    assert got == golden, (got, golden)
+
+
+def test_tied_embedding_checkpoint(tmp_path):
+    model, tensors = _write_checkpoint(tmp_path, tied=True)
+    assert "lm_head.weight" not in tensors
+    params = load_llama_params(str(tmp_path), OUR_CFG)
+    np.testing.assert_array_equal(
+        np.asarray(params["lm_head"]), tensors["model.embed_tokens.weight"].T
+    )
+
+    ids = np.array([[2, 40, 77]], np.int32)
+    with torch.no_grad():
+        ref = model(torch.from_numpy(ids.astype(np.int64))).logits.numpy()
+    positions = np.arange(ids.shape[1], dtype=np.int32)[None, :]
+    ours = np.asarray(
+        forward_full(params, jnp.asarray(ids), jnp.asarray(positions),
+                     config=OUR_CFG, attn_backend="ref")
+    )
+    np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-3)
+
+
+def test_config_mismatch_raises(checkpoint):
+    path, _, _ = checkpoint
+    wrong = LlamaConfig(
+        vocab_size=128, dim=64, n_layers=3, n_heads=4, n_kv_heads=2,
+        hidden_dim=96, dtype=jnp.float32,
+    )
+    with pytest.raises(ValueError, match="num_hidden_layers"):
+        load_llama_params(str(path), wrong)

@@ -1,0 +1,138 @@
+"""Vector-index durability + ingestion surface (VERDICT r1 task 5).
+
+The reference's transaction data lives in an external durable Qdrant fed by
+an out-of-band pipeline (qdrant_tool.py:24-37); here ingestion is
+first-class (POST /transactions + the transaction_upsert Kafka topic) and
+the on-device index snapshots to ``vector.persist_path`` so retrieval is
+not empty-at-boot."""
+
+import asyncio
+import json
+
+import numpy as np
+
+from finchat_tpu.embed.index import DeviceVectorIndex, VectorPoint
+from finchat_tpu.engine.generator import StubGenerator
+from finchat_tpu.io.kafka import InMemoryBroker, KafkaClient, Message
+from finchat_tpu.io.store import InMemoryStore
+from finchat_tpu.serve.app import build_app
+from finchat_tpu.utils.config import TRANSACTION_UPSERT_TOPIC, load_config
+
+
+def test_index_snapshot_roundtrip(tmp_path):
+    base = str(tmp_path / "snap")
+    index = DeviceVectorIndex(dim=4)
+    index.upsert([
+        VectorPoint(
+            id=f"p{i}", vector=np.eye(4)[i % 4].astype(np.float32),
+            payload={"page_content": f"txn {i}",
+                     "metadata": {"user_id": "u", "date": 100.0 + i}},
+        )
+        for i in range(6)
+    ])
+    index.save(base)
+
+    restored = DeviceVectorIndex.load(base, dim=4)
+    assert len(restored) == 6
+    hits = restored.query_points(
+        np.asarray([1, 0, 0, 0], np.float32), limit=10, user_id="u"
+    )
+    assert {h.payload["page_content"] for h in hits} == {f"txn {i}" for i in range(6)}
+    # date filter data survived too
+    hits = restored.query_points(
+        np.asarray([1, 0, 0, 0], np.float32), limit=10, user_id="u", date_gte=104.0
+    )
+    assert {h.payload["page_content"] for h in hits} == {"txn 4", "txn 5"}
+
+
+def test_load_missing_snapshot_is_empty(tmp_path):
+    index = DeviceVectorIndex.load(str(tmp_path / "absent"), dim=4)
+    assert len(index) == 0
+
+
+def _make_app(tmp_path):
+    cfg = load_config(overrides={
+        "model.preset": "stub",
+        "vector.persist_path": str(tmp_path / "vectors"),
+    })
+    broker = InMemoryBroker()
+    store = InMemoryStore()
+    app = build_app(
+        cfg, store=store, kafka=KafkaClient(cfg.kafka, broker=broker),
+        tool_generator=StubGenerator(default="No tool call"),
+        response_generator=StubGenerator(default="ok"),
+    )
+    return app, broker
+
+
+ROWS = [
+    {"text": "Spent $4.50 at Blue Bottle Coffee", "date": 1000.0, "amount": -4.5},
+    {"text": "Rent payment $1800", "date": 2000.0, "amount": -1800.0},
+]
+
+
+def test_boot_ingest_retrieve_persist_roundtrip(tmp_path):
+    """boot → ingest → retrieve → reboot: data survives the restart."""
+
+    async def first_boot():
+        app, _ = _make_app(tmp_path)
+        count = await asyncio.to_thread(app._ingest_rows, "u1", ROWS)
+        assert count == 2
+        rows = await app.retriever.structured(
+            {"user_id": "u1", "search_query": "coffee"}
+        )
+        assert len(rows) == 2
+        assert all(r["user_id"] == "u1" for r in rows)
+        # wrong user sees nothing (security invariant holds on ingested data)
+        assert await app.retriever({"user_id": "other"}) == []
+
+    asyncio.run(first_boot())
+
+    async def second_boot():
+        app, _ = _make_app(tmp_path)  # fresh app, same persist path
+        rows = await app.retriever.structured(
+            {"user_id": "u1", "search_query": "rent"}
+        )
+        texts = {r["page_content"] for r in rows}
+        assert texts == {ROWS[0]["text"], ROWS[1]["text"]}
+        # structured metadata (the plot tool's input) survived the snapshot
+        assert {r.get("amount") for r in rows} == {-4.5, -1800.0}
+
+    asyncio.run(second_boot())
+
+
+def test_kafka_upsert_topic_ingests(tmp_path):
+    async def run():
+        app, broker = _make_app(tmp_path)
+        payload = {"user_id": "u2", "transactions": ROWS}
+        msg = Message(TRANSACTION_UPSERT_TOPIC, "u2", json.dumps(payload).encode())
+        await app.process_upsert(msg)
+        return await app.retriever({"user_id": "u2"})
+
+    texts = asyncio.run(run())
+    assert len(texts) == 2
+
+
+def test_http_upsert_endpoint(tmp_path):
+    """POST /transactions through the real handler (request object faked)."""
+
+    class Req:
+        def __init__(self, body):
+            self._body = body
+
+        def json(self):
+            return self._body
+
+    async def run():
+        app, _ = _make_app(tmp_path)
+        resp = await app.upsert_transactions(Req({"user_id": "u3", "transactions": ROWS}))
+        assert json.loads(resp.body.decode())["upserted"] == 2
+        bad = await app.upsert_transactions(Req({"user_id": "u3"}))
+        assert bad.status == 400
+        bad2 = await app.upsert_transactions(
+            Req({"user_id": "u3", "transactions": [{"date": 1.0}]})
+        )
+        assert bad2.status == 400
+        return await app.retriever({"user_id": "u3"})
+
+    assert len(asyncio.run(run())) == 2

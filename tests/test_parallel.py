@@ -13,12 +13,34 @@ from finchat_tpu.parallel.mesh import MeshSpec, build_mesh
 
 
 def test_mesh_spec_resolution():
-    assert MeshSpec(data=2, model=-1).resolve(8) == (2, 1, 1, 4)
-    assert MeshSpec(data=1, seq=1, expert=1, model=8).resolve(8) == (1, 1, 1, 8)
+    assert MeshSpec(data=2, model=-1).resolve(8) == (2, 1, 1, 1, 4)
+    assert MeshSpec(data=1, seq=1, expert=1, model=8).resolve(8) == (1, 1, 1, 1, 8)
+    assert MeshSpec(data=1, pipe=2, model=-1).resolve(8) == (1, 2, 1, 1, 4)
     with pytest.raises(ValueError):
         MeshSpec(data=3, model=-1).resolve(8)
     with pytest.raises(ValueError):
         MeshSpec(data=2, model=2).resolve(8)  # product mismatch
+
+
+def test_pipe_axis_tolerated_by_shardings():
+    """SURVEY §2.3: the PP axis exists in the mesh and param/state shardings
+    (which never name 'pipe') place cleanly on a pipe>1 mesh."""
+    from finchat_tpu.engine.engine import create_state
+    from finchat_tpu.parallel.sharding import (
+        llama_param_shardings, shard_decode_state, shard_params,
+    )
+    from finchat_tpu.utils.config import EngineConfig
+
+    mesh = build_mesh(MeshSpec(data=1, pipe=2, seq=1, expert=1, model=4))
+    assert mesh.shape["pipe"] == 2
+    config = LlamaConfig(
+        vocab_size=64, dim=32, n_layers=2, n_heads=4, n_kv_heads=4,
+        hidden_dim=64, max_seq_len=32,
+    )
+    params = shard_params(init_params(config, jax.random.key(0)), llama_param_shardings(mesh))
+    ecfg = EngineConfig(max_seqs=2, page_size=8, num_pages=16, max_seq_len=32, prefill_chunk=8)
+    state = shard_decode_state(create_state(config, ecfg, 4), mesh)
+    assert state.k_pages.sharding.mesh.shape["pipe"] == 2
 
 
 def test_ring_attention_matches_reference():

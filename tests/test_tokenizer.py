@@ -1,7 +1,18 @@
-"""Byte tokenizer, UTF-8-safe streaming detokenizer, chat template."""
+"""Byte tokenizer, HF tokenizer adapter, UTF-8-safe streaming detokenizer,
+chat template."""
+
+import json
+
+import pytest
 
 from finchat_tpu.io.schemas import ChatMessage
-from finchat_tpu.models.tokenizer import ByteTokenizer, IncrementalDecoder, render_chat
+from finchat_tpu.models.tokenizer import (
+    ByteTokenizer,
+    HFTokenizer,
+    IncrementalDecoder,
+    get_tokenizer,
+    render_chat,
+)
 
 
 def test_byte_roundtrip():
@@ -43,6 +54,71 @@ def test_incremental_decoder_garbage_does_not_stall():
     # 0xFF is never valid UTF-8; a run of them must flush as replacements
     out = "".join(dec.push(0xFF) for _ in range(6))
     assert "�" in out  # emitted, not buffered forever
+
+
+# --- HFTokenizer over a locally-built tokenizer dir (no network) -----------
+
+
+@pytest.fixture(scope="module")
+def hf_tokenizer_dir(tmp_path_factory):
+    tokenizers = pytest.importorskip("tokenizers")
+    from tokenizers import Tokenizer, decoders, models, pre_tokenizers, trainers
+
+    path = tmp_path_factory.mktemp("hf_tok")
+    tok = Tokenizer(models.BPE(unk_token=None))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=320,
+        special_tokens=["<s>", "</s>", "<pad>"],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+    )
+    tok.train_from_iterator(
+        ["hello world", "what did I spend on groceries?",
+         "retrieve_transactions", '{"search_query": "recent"}', "🎉 良い"],
+        trainer,
+    )
+    tok.save(str(path / "tokenizer.json"))
+    (path / "tokenizer_config.json").write_text(json.dumps({
+        "tokenizer_class": "PreTrainedTokenizerFast",
+        "bos_token": "<s>", "eos_token": "</s>", "pad_token": "<pad>",
+    }))
+    return path
+
+
+def test_hf_tokenizer_roundtrip_and_specials(hf_tokenizer_dir):
+    pytest.importorskip("transformers")
+    tok = HFTokenizer(str(hf_tokenizer_dir))
+    assert tok.vocab_size > 0
+    assert tok.bos_id != tok.eos_id
+    text = "what did I spend on groceries?"
+    ids = tok.encode(text)
+    assert tok.decode(ids) == text
+    with_bos = tok.encode(text, add_bos=True)
+    assert with_bos[0] == tok.bos_id and with_bos[1:] == ids
+
+
+def test_get_tokenizer_dispatch(hf_tokenizer_dir):
+    pytest.importorskip("transformers")
+    assert isinstance(get_tokenizer(""), ByteTokenizer)
+    assert isinstance(get_tokenizer(str(hf_tokenizer_dir)), HFTokenizer)
+
+
+def test_incremental_decoder_hf_path(hf_tokenizer_dir):
+    """The HF branch of IncrementalDecoder: multibyte text split across
+    byte-fallback pieces streams without mojibake."""
+    pytest.importorskip("transformers")
+    tok = HFTokenizer(str(hf_tokenizer_dir))
+    text = "hello 🎉 良い world"
+    ids = tok.encode(text)
+    dec = IncrementalDecoder(tok)
+    out = ""
+    for t in ids:
+        piece = dec.push(t)
+        assert "�" not in piece
+        out += piece
+    out += dec.flush()
+    assert out == text
 
 
 def test_render_chat_structure():
