@@ -38,8 +38,11 @@ BASELINE_TOK_S_PER_CHIP = 2000.0  # BASELINE.md north star
 # one chip's HBM, at the north-star concurrency (64 sessions). CPU: the
 # "mini" debug config so the fallback finishes in seconds.
 DEFAULTS = {
+    # page_size 256: the decode attention grid is (B, 1, max_pages) per
+    # layer — bigger pages halve the grid-iteration overhead (~1 µs each on
+    # v5e) at the cost of coarser allocation granularity
     "tpu": dict(preset="tinyllama-1.1b", batch=64, prompt_len=128, steps=128,
-                warmup=8, page_size=128, max_seq_len=1024),
+                warmup=8, page_size=256, max_seq_len=1024),
     "cpu": dict(preset="mini", batch=8, prompt_len=128, steps=16,
                 warmup=2, page_size=128, max_seq_len=1024),
 }
@@ -143,15 +146,19 @@ def measure(preset: str, batch: int, prompt_len: int, steps: int, warmup: int,
     params = init_params(config, jax.random.key(0))
     engine = InferenceEngine(config, params, engine_cfg, attn_backend=attn)
 
-    # assign pages + prefill a random prompt into every slot
+    # assign pages + prefill a random prompt into every slot — all slots
+    # batched into one prefill_step round (one weights-read per chunk round
+    # for the WHOLE batch; the round-3 serial path took 8.6 s for 64x128)
     rng = np.random.default_rng(0)
     next_page = 1  # page 0 is the trash page
     t_prefill0 = time.perf_counter()
+    items = []
     for slot in range(batch):
         engine.set_page_table_row(slot, list(range(next_page, next_page + pages_per_seq)))
         next_page += pages_per_seq
         prompt = rng.integers(1, config.vocab_size, size=prompt_len).tolist()
-        engine.prefill(slot, prompt)
+        items.append((slot, prompt))
+    engine.prefill_batch(items)
     np.asarray(engine.state.context_lens)  # host fetch = execution barrier
     prefill_s = time.perf_counter() - t_prefill0
     print(f"[bench] prefill {batch}x{prompt_len} in {prefill_s:.1f}s "

@@ -1,0 +1,123 @@
+"""Bisect where the dense decode forward's time goes (one-off diagnostic)."""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def main() -> int:
+    import faulthandler
+
+    faulthandler.dump_traceback_later(560.0, exit=True)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from finchat_tpu.models.llama import PRESETS, forward, init_params, make_causal_attention
+
+    config = PRESETS["tinyllama-1.1b"]
+    params = init_params(config, jax.random.key(0))
+    B = 64
+    tokens = jnp.zeros((B, 1), jnp.int32)
+    pos = jnp.zeros((B, 1), jnp.int32)
+    dev = jax.devices()[0]
+    print(f"[bisect] {dev}", file=sys.stderr, flush=True)
+    results = {}
+
+    def timeit(name, fn, iters=20, warmup=3):
+        for _ in range(warmup):
+            out = fn()
+        np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn()
+        np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
+        ms = 1000 * (time.perf_counter() - t0) / iters
+        print(f"[bisect] {name}: {ms:.2f} ms", file=sys.stderr, flush=True)
+        results[name] = round(ms, 2)
+
+    # A: full forward (dense attention)
+    @jax.jit
+    def full(params, tokens, pos):
+        logits, _ = forward(params, tokens, pos, config=config,
+                            attention=make_causal_attention("ref"), cache=None)
+        return logits
+
+    timeit("A_full_forward", lambda: full(params, tokens, pos))
+
+    # B: no lm_head
+    from finchat_tpu.models.llama import _layer, rms_norm
+
+    def body_maker(attention):
+        def scan_body(carry, scanned):
+            x = carry
+            layer_params, layer_idx = scanned
+            x, _ = _layer(x, layer_params, None, layer_idx,
+                          positions=pos, config=config, attention=attention)
+            return x, None
+        return scan_body
+
+    @jax.jit
+    def no_head(params, tokens):
+        x = params["embed"][tokens]
+        x, _ = jax.lax.scan(body_maker(make_causal_attention("ref")), x,
+                            (params["layers"], jnp.arange(config.n_layers)))
+        return rms_norm(x, params["norm"], config.norm_eps)
+
+    timeit("B_no_head", lambda: no_head(params, tokens))
+
+    # C: layers only, attention = identity on q
+    def ident_attn(q, k, v, cache, idx):
+        return q, cache
+
+    @jax.jit
+    def ident(params, tokens):
+        x = params["embed"][tokens]
+        x, _ = jax.lax.scan(body_maker(ident_attn), x,
+                            (params["layers"], jnp.arange(config.n_layers)))
+        return x
+
+    timeit("C_ident_attn", lambda: ident(params, tokens))
+
+    # D: head only
+    @jax.jit
+    def head_only(params, x):
+        return jnp.einsum("bsd,dv->bsv", x, params["lm_head"],
+                          preferred_element_type=jnp.float32)
+
+    x0 = jnp.zeros((B, 1, config.dim), config.dtype)
+    timeit("D_head_only", lambda: head_only(params, x0))
+
+    # E: unrolled layers (no scan), identity attention
+    @jax.jit
+    def unrolled(params, tokens):
+        x = params["embed"][tokens]
+        for i in range(config.n_layers):
+            lp = jax.tree_util.tree_map(lambda a: a[i], params["layers"])
+            x, _ = _layer(x, lp, None, jnp.int32(i),
+                          positions=pos, config=config, attention=ident_attn)
+        return x
+
+    timeit("E_unrolled_ident", lambda: unrolled(params, tokens))
+
+    # F: dense ref attention cost alone at S=1 (22 calls in scan)
+    q = jnp.zeros((B, 1, config.n_heads, config.head_dim), config.dtype)
+
+    @jax.jit
+    def attn_only(q):
+        def body(c, _):
+            out, _ = make_causal_attention("ref")(q, q[:, :, :config.n_kv_heads], q[:, :, :config.n_kv_heads], None, 0)
+            return c + jnp.sum(out.astype(jnp.float32)), None
+        c, _ = jax.lax.scan(body, jnp.float32(0), None, length=config.n_layers)
+        return c
+
+    timeit("F_attn_only", lambda: attn_only(q))
+
+    print(json.dumps(results), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

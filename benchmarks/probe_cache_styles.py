@@ -1,0 +1,124 @@
+"""Compare KV-cache plumbing styles through lax.scan, donated, on-chip.
+
+Style A (current engine): cache leaves are scan xs, updated per layer,
+re-stacked as ys. Style B: cache is part of the scan carry, scattered in
+place with a leading layer index. Style C: floor — scan that only READS the
+cache (no update). All three run under donate_argnums so XLA may alias.
+
+The winner becomes the engine's forward-pass cache structure.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from functools import partial
+
+
+def main() -> int:
+    import faulthandler
+
+    faulthandler.dump_traceback_later(560.0, exit=True)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    L, P, Hkv, PS, D = 22, 264, 4, 256, 64
+    B = 64
+    dtype = jnp.bfloat16
+    dev = jax.devices()[0]
+    print(f"[cache] {dev}; cache {2 * L * P * Hkv * PS * D * 2 / 1e9:.2f} GB", file=sys.stderr, flush=True)
+
+    def fresh():
+        return (jnp.zeros((L, P, Hkv, PS, D), dtype),
+                jnp.zeros((L, P, Hkv, PS, D), dtype))
+
+    k_pages, v_pages = fresh()
+    k_new = jnp.ones((B, 1, Hkv, D), dtype)
+    phys = jnp.arange(B, dtype=jnp.int32) % (P - 1) + 1  # [B]
+    off = jnp.full((B,), 7, jnp.int32)
+
+    results = {}
+
+    def timeit(name, fn, state_factory, iters=20):
+        out = fn(*state_factory())
+        for _ in range(3):
+            out = fn(*out)
+        np.asarray(out[0].ravel()[:1])
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*out)
+        np.asarray(out[0].ravel()[:1])
+        ms = 1000 * (time.perf_counter() - t0) / iters
+        print(f"[cache] {name}: {ms:.2f} ms", file=sys.stderr, flush=True)
+        results[name] = round(ms, 2)
+
+    # Style A: xs -> ys (current)
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def style_a(k_pages, v_pages):
+        def body(carry, kv):
+            k_l, v_l = kv
+            k_l = k_l.at[phys, :, off].set(k_new[:, 0])
+            v_l = v_l.at[phys, :, off].set(k_new[:, 0])
+            return carry + jnp.sum(k_l[0, 0, 0, :1].astype(jnp.float32)), (k_l, v_l)
+
+        s, (k2, v2) = jax.lax.scan(body, jnp.float32(0), (k_pages, v_pages))
+        return k2, v2
+
+    timeit("A_xs_to_ys", style_a, fresh)
+
+    # Style B: carry with layer-indexed in-place scatter
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def style_b(k_pages, v_pages):
+        def body(carry, layer_idx):
+            k_pg, v_pg, s = carry
+            k_pg = k_pg.at[layer_idx, phys, :, off].set(k_new[:, 0])
+            v_pg = v_pg.at[layer_idx, phys, :, off].set(k_new[:, 0])
+            s = s + jnp.sum(k_pg[0, 0, 0, :1].astype(jnp.float32))
+            return (k_pg, v_pg, s), None
+
+        (k2, v2, s), _ = jax.lax.scan(
+            body, (k_pages, v_pages, jnp.float32(0)), jnp.arange(L))
+        return k2, v2
+
+    timeit("B_carry_scatter", style_b, fresh)
+
+    # Style C: read-only floor (no update at all)
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def style_c(k_pages, v_pages):
+        def body(carry, kv):
+            k_l, v_l = kv
+            return carry + jnp.sum(k_l[0, 0, 0, :1].astype(jnp.float32)), None
+
+        s, _ = jax.lax.scan(body, jnp.float32(0), (k_pages, v_pages))
+        return k_pages + 0 * s.astype(dtype), v_pages  # keep donation shape
+
+    # C mutates nothing; time it non-donated style for reference
+    @jax.jit
+    def style_c2(k_pages, v_pages):
+        def body(carry, kv):
+            k_l, v_l = kv
+            return carry + jnp.sum(k_l[0, 0, 0, :1].astype(jnp.float32)), None
+
+        s, _ = jax.lax.scan(body, jnp.float32(0), (k_pages, v_pages))
+        return s
+
+    k_pages, v_pages = fresh()
+    for _ in range(3):
+        s = style_c2(k_pages, v_pages)
+    np.asarray(s)
+    t0 = time.perf_counter()
+    for _ in range(20):
+        s = style_c2(k_pages, v_pages)
+    np.asarray(s)
+    ms = 1000 * (time.perf_counter() - t0) / 20
+    print(f"[cache] C_read_only: {ms:.2f} ms", file=sys.stderr, flush=True)
+    results["C_read_only"] = round(ms, 2)
+
+    print(json.dumps(results), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,96 @@
+"""Raw device floor probes: dispatch overhead, HBM bandwidth, MXU throughput.
+
+Separates "the engine is slow" from "every dispatch through this backend has
+a fixed cost" — needed to interpret profile_decode.py numbers.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def main() -> int:
+    import faulthandler
+
+    faulthandler.dump_traceback_later(400.0, exit=True)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    dev = jax.devices()[0]
+    print(f"[probe] backend: {dev}", file=sys.stderr, flush=True)
+    results = {"device": str(dev), "platform": dev.platform}
+
+    def timeit(name, fn, iters, warmup=3):
+        for _ in range(warmup):
+            out = fn()
+        np.asarray(jnp.sum(out))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn()
+        np.asarray(jnp.sum(out))
+        ms = 1000 * (time.perf_counter() - t0) / iters
+        print(f"[probe] {name}: {ms:.3f} ms", file=sys.stderr, flush=True)
+        return round(ms, 3)
+
+    # 1. dispatch overhead: tiny jitted op, amortized over a long async queue
+    x = jnp.zeros((8, 128), jnp.float32)
+    tiny = jax.jit(lambda x: x + 1.0)
+    results["tiny_dispatch_ms_x100"] = timeit("tiny x100", lambda: tiny(x), 100)
+    results["tiny_dispatch_ms_x10"] = timeit("tiny x10", lambda: tiny(x), 10)
+
+    # 2. chained tiny: y = f(f(f(...))) 50 deep in ONE jit — device-side cost
+    @jax.jit
+    def chain(x):
+        for _ in range(50):
+            x = x + 1.0
+        return x
+
+    results["chain50_ms"] = timeit("chain50 (1 dispatch)", lambda: chain(x), 20)
+
+    # 3. HBM bandwidth: reduce a 2 GiB bf16 array
+    big = jnp.zeros((1024, 1024, 1024), jnp.bfloat16)  # 2 GiB
+    red = jax.jit(lambda a: jnp.sum(a.astype(jnp.float32)))
+    ms = timeit("sum 2GiB", lambda: red(big), 10)
+    results["hbm_read_2gib_ms"] = ms
+    results["hbm_gbps"] = round(2.0 / (ms / 1000), 1)
+
+    # 4. MXU: bf16 matmul 4096^3
+    a = jnp.zeros((4096, 4096), jnp.bfloat16)
+    mm = jax.jit(lambda a, b: a @ b)
+    ms = timeit("matmul 4096^3", lambda: mm(a, a), 20)
+    results["matmul4096_ms"] = ms
+    results["tflops"] = round(2 * 4096**3 / (ms / 1000) / 1e12, 1)
+
+    # 5. decode-shaped matmul chain: 22 layers x 7 matmuls at [64, d] sizes
+    # (mimics the TinyLlama step's weight reads in one jit, no attention)
+    D, F, V = 2048, 5632, 32000
+    Wq = jnp.zeros((22, D, D), jnp.bfloat16)
+    Wg = jnp.zeros((22, D, F), jnp.bfloat16)
+    Wd = jnp.zeros((22, F, D), jnp.bfloat16)
+    Wv = jnp.zeros((D, V), jnp.bfloat16)
+    h0 = jnp.zeros((64, D), jnp.bfloat16)
+
+    @jax.jit
+    def decode_shaped(h, Wq, Wg, Wd, Wv):
+        def body(h, w):
+            wq, wg, wd = w
+            h = h + (h @ wq)
+            u = h @ wg
+            h = h + (u @ wd)
+            return h, None
+
+        h, _ = jax.lax.scan(body, h, (Wq, Wg, Wd))
+        return h @ Wv
+
+    results["decode_shaped_ms"] = timeit(
+        "decode-shaped scan (1 dispatch)", lambda: decode_shaped(h0, Wq, Wg, Wd, Wv), 20)
+
+    print(json.dumps(results), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

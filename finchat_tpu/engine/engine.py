@@ -2,16 +2,21 @@
 KV cache.
 
 TPU-first shape discipline (SURVEY §7.3 hard part #2): every jitted entry
-point has ONE static shape —
+point has ONE static shape per (batch-bucket) —
 
-- ``prefill_step``: batch 1 × ``prefill_chunk`` tokens. Arbitrary prompt
-  lengths become a loop of fixed-size chunks (chunked prefill, SURVEY §5.7a)
-  so there is no bucketing recompile storm.
+- ``prefill_step``: ``N × prefill_chunk`` tokens — N sequences advance one
+  chunk together (batched prefill; a 64-session burst is a handful of
+  steps, not 64 serial weight-reads — the round-3 bench measured 8.6 s for
+  64×128-token prompts through the old one-sequence-at-a-time path).
+  Arbitrary prompt lengths become rounds of fixed-size chunks (chunked
+  prefill, SURVEY §5.7a) so there is no bucketing recompile storm;
+  exhausted prompts ride later rounds with ``n_valid = 0``.
 - ``decode_step``: the full ``max_seqs`` slot batch, every step. Inactive
   slots ride along writing their KV to the trash page.
 
-State is donated on every call, so XLA aliases the cache buffers in place
-instead of copying the multi-GB pages each token.
+State is donated on every call and the KV cache is updated IN PLACE by the
+Pallas append kernel (ops/kv_append.py) on the decode path — XLA's scatter
+would copy the multi-GB cache every token (measured ~22 ms/step, round 4).
 """
 
 from __future__ import annotations
@@ -43,7 +48,7 @@ logger = get_logger(__name__)
 class DecodeState:
     """Device-resident engine state (a pytree; all leaves are arrays)."""
 
-    k_pages: Array  # [L, P, Hkv, page_size, hd]
+    k_pages: Array  # [L, P, page_size, Hkv*hd]
     v_pages: Array
     page_table: Array  # [max_seqs, max_pages_per_seq] int32 (0 = trash)
     context_lens: Array  # [max_seqs] int32 — tokens whose KV is cached
@@ -65,22 +70,46 @@ def create_state(
     )
 
 
-def _paged_attention_fn(page_table: Array, start_pos: Array, n_valid: Array, page_size: int, attn_backend: str):
+def _paged_attention_fn(
+    page_table: Array, start_pos: Array, n_valid: Array,
+    page_size: int, n_kv: int, attn_backend: str,
+):
     """Build the model's attention callback for paged prefill/decode.
 
     ``page_table`` [B, max_pages], ``start_pos`` [B] (absolute position of
     the first query token), ``n_valid`` [B] (real tokens in this chunk; 0
-    for inactive decode slots).
+    for inactive decode slots). The callback receives the FULL-depth cache
+    (carried through the layer scan) plus the layer index.
     """
+    interpret = True if attn_backend == "pallas-interpret" else None
 
-    def attention(q: Array, k: Array, v: Array, layer_cache: Any, layer_idx: Array):
-        k_l, v_l = layer_cache
-        k_l, v_l = scatter_kv_chunk(k_l, v_l, k, v, page_table, start_pos, n_valid, page_size)
+    def attention(q: Array, k: Array, v: Array, cache: Any, layer_idx: Array):
+        k_pages, v_pages = cache
+        B, C = k.shape[:2]
+        layer = layer_idx.reshape(1)
+        if C == 1 and attn_backend != "ref":
+            # decode: in-place single-page RMW append (no cache copy)
+            from finchat_tpu.ops.kv_append import paged_kv_append
+
+            kv_new = jnp.concatenate(
+                [k.reshape(B, 1, -1), v.reshape(B, 1, -1)], axis=-1
+            )
+            k_pages, v_pages = paged_kv_append(
+                kv_new, k_pages, v_pages, page_table, start_pos, n_valid,
+                layer, page_size=page_size, interpret=interpret,
+            )
+        else:
+            # prefill chunk (or jnp reference path): XLA scatter — one
+            # cache copy amortized over the whole batched chunk
+            k_pages, v_pages = scatter_kv_chunk(
+                k_pages, v_pages, k, v, page_table, start_pos, n_valid,
+                page_size, layer_idx,
+            )
         out = paged_attention(
-            q, k_l, v_l, page_table, start_pos, start_pos + n_valid,
-            page_size=page_size, backend=attn_backend,
+            q, k_pages, v_pages, page_table, start_pos, start_pos + n_valid,
+            layer, page_size=page_size, n_kv=n_kv, backend=attn_backend,
         )
-        return out, (k_l, v_l)
+        return out, (k_pages, v_pages)
 
     return attention
 
@@ -89,35 +118,38 @@ def _paged_attention_fn(page_table: Array, start_pos: Array, n_valid: Array, pag
 def prefill_step(
     params: dict[str, Any],
     state: DecodeState,
-    tokens: Array,  # [1, C] — one chunk of one sequence's prompt
-    slot: Array,  # scalar int32
-    start_pos: Array,  # scalar int32 — absolute position of tokens[0]
-    n_valid: Array,  # scalar int32 — real tokens in this chunk
+    tokens: Array,  # [N, C] — one chunk of N sequences' prompts
+    slots: Array,  # [N] int32
+    start_pos: Array,  # [N] int32 — absolute position of tokens[i, 0]
+    n_valid: Array,  # [N] int32 — real tokens in this chunk per sequence
     *,
     config: LlamaConfig,
     page_size: int,
     attn_backend: str = "ref",
 ) -> tuple[DecodeState, Array]:
-    """Run one prefill chunk; returns (state, last-valid-token logits [vocab])."""
-    C = tokens.shape[1]
-    positions = (start_pos + jnp.arange(C))[None, :]  # [1, C]
-    page_row = jax.lax.dynamic_slice_in_dim(state.page_table, slot, 1, axis=0)  # [1, max_pages]
+    """Run one prefill chunk for N sequences; returns (state,
+    last-valid-token logits [N, vocab])."""
+    N, C = tokens.shape
+    positions = start_pos[:, None] + jnp.arange(C)[None, :]  # [N, C]
+    page_rows = state.page_table[slots]  # [N, max_pages]
 
-    attention = _paged_attention_fn(page_row, start_pos[None], n_valid[None], page_size, attn_backend)
+    attention = _paged_attention_fn(
+        page_rows, start_pos, n_valid, page_size, config.n_kv_heads, attn_backend
+    )
     logits, (k_pages, v_pages) = forward(
         params, tokens, positions,
         config=config, attention=attention,
         cache=(state.k_pages, state.v_pages),
     )
     last_logits = jnp.take_along_axis(
-        logits[0], jnp.maximum(n_valid - 1, 0)[None, None], axis=0
-    )[0]  # [vocab]
+        logits, jnp.maximum(n_valid - 1, 0)[:, None, None], axis=1
+    )[:, 0]  # [N, vocab]
 
     new_state = dataclasses.replace(
         state,
         k_pages=k_pages,
         v_pages=v_pages,
-        context_lens=state.context_lens.at[slot].add(n_valid),
+        context_lens=state.context_lens.at[slots].add(n_valid),
     )
     return new_state, last_logits
 
@@ -166,12 +198,14 @@ def decode_step(
     (fp32) — the host-side path for grammar-constrained sampling
     (agent/constrained.py), which overrides ``last_tokens`` afterwards.
     """
-    B = state.last_tokens.shape[0]
     tokens = state.last_tokens[:, None]  # [B, 1]
     positions = state.context_lens[:, None]  # [B, 1]
     n_valid = active.astype(jnp.int32)  # [B]
 
-    attention = _paged_attention_fn(state.page_table, state.context_lens, n_valid, page_size, attn_backend)
+    attention = _paged_attention_fn(
+        state.page_table, state.context_lens, n_valid,
+        page_size, config.n_kv_heads, attn_backend,
+    )
     logits, (k_pages, v_pages) = forward(
         params, tokens, positions,
         config=config, attention=attention,
@@ -217,7 +251,8 @@ class InferenceEngine:
         state = create_state(config, engine_cfg, self.max_pages_per_seq)
         if mesh is not None:
             # TP placement: params sharded Megatron-style, KV pages sharded
-            # over KV heads on the model axis; XLA propagates the rest.
+            # over the fused KV-head dim on the model axis; XLA propagates
+            # the rest.
             from finchat_tpu.parallel.sharding import (
                 llama_param_shardings,
                 shard_decode_state,
@@ -252,26 +287,50 @@ class InferenceEngine:
             last_tokens=self.state.last_tokens.at[slot].set(0),
         )
 
-    def prefill(self, slot: int, prompt_ids: list[int]) -> Array:
-        """Chunked prefill of a whole prompt into a slot; returns the final
-        chunk's last-token logits."""
+    def prefill_batch(self, items: list[tuple[int, list[int]]]) -> list[Array]:
+        """Chunked prefill of N whole prompts together; returns each
+        sequence's final-chunk last-token logits (one [vocab] array per
+        item, in input order).
+
+        All N sequences advance one ``prefill_chunk`` per round; prompts
+        that are exhausted ride the remaining rounds with ``n_valid = 0``
+        (their KV writes go to the trash page). One weights-read serves the
+        whole batch per round instead of per sequence.
+        """
+        assert items, "empty prefill batch"
         C = self.engine_cfg.prefill_chunk
-        start = 0
-        last_logits = None
-        while start < len(prompt_ids):
-            chunk = prompt_ids[start : start + C]
-            n_valid = len(chunk)
-            padded = chunk + [0] * (C - n_valid)
-            tokens = jnp.asarray(padded, jnp.int32)[None, :]
-            self.state, last_logits = prefill_step(
-                self.params, self.state, tokens,
-                jnp.int32(slot), jnp.int32(start), jnp.int32(n_valid),
+        N = len(items)
+        slots = jnp.asarray([slot for slot, _ in items], jnp.int32)
+        prompts = [ids for _, ids in items]
+        assert all(prompts), "empty prompt in prefill batch"
+        rounds = max(-(-len(p) // C) for p in prompts)
+        last_logits: list[Array | None] = [None] * N
+        for r in range(rounds):
+            chunk_tokens = []
+            n_valid = []
+            start = []
+            for p in prompts:
+                chunk = p[r * C:(r + 1) * C]
+                n_valid.append(len(chunk))
+                start.append(min(r * C, len(p)))
+                chunk_tokens.append(chunk + [0] * (C - len(chunk)))
+            self.state, logits = prefill_step(
+                self.params, self.state,
+                jnp.asarray(chunk_tokens, jnp.int32), slots,
+                jnp.asarray(start, jnp.int32), jnp.asarray(n_valid, jnp.int32),
                 config=self.config, page_size=self.page_size,
                 attn_backend=self.attn_backend,
             )
-            start += n_valid
-        assert last_logits is not None, "empty prompt"
-        return last_logits
+            for i, p in enumerate(prompts):
+                if n_valid[i] and r * C + n_valid[i] == len(p):
+                    last_logits[i] = logits[i]
+        assert all(l is not None for l in last_logits)
+        return last_logits  # type: ignore[return-value]
+
+    def prefill(self, slot: int, prompt_ids: list[int]) -> Array:
+        """Chunked prefill of a whole prompt into a slot; returns the final
+        chunk's last-token logits."""
+        return self.prefill_batch([(slot, prompt_ids)])[0]
 
     def decode(self, active, temperature, top_p, top_k, return_logits: bool = False):
         self.state, next_tokens, logits = decode_step(

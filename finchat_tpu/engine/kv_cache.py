@@ -6,12 +6,21 @@ retrieved transactions, reference qdrant_tool.py:145 / llm_agent.py:234-236)
 servable on fixed TPU HBM:
 
 - Device side: ``k_pages``/``v_pages`` shaped ``[n_layers, num_pages,
-  n_kv_heads, page_size, head_dim]`` — head-major, so one head's page is a
-  contiguous ``(page_size, head_dim)`` tile, the unit the Pallas paged-
-  attention kernel DMAs (Mosaic wants the trailing two dims tile-aligned).
-  Physical page 0 is a TRASH page —
-  writes from padding lanes and inactive slots are redirected there, which
-  keeps every jitted step a fixed-shape scatter with no host branching.
+  page_size, n_kv_heads * head_dim]`` — token-major pages with the KV heads
+  fused into the minor dim. This layout is chosen for Mosaic's DMA tiling
+  rules (measured on v5e, round 4): a page's trailing dims
+  ``(page_size, Hkv*hd)`` are tile-aligned, so the in-place decode append
+  kernel (ops/kv_append.py) can RMW one whole page per sequence with legal
+  full-extent DMAs, and the paged attention kernel (ops/paged_attention.py)
+  value-slices per-head ``[PS, hd]`` tiles out of the loaded block. The
+  leading layer axis exists because the cache rides the model's layer scan
+  as a CARRY (not xs→ys): XLA restacks xs→ys cache updates into a fresh
+  buffer every step — a full-cache copy measured at ~22 ms/step for a 1.5 GB
+  cache — while kernels with ``input_output_aliases`` update the carried
+  buffer in place.
+  Physical page 0 is a TRASH page — writes from padding lanes and inactive
+  slots are redirected there, which keeps every jitted step a fixed-shape
+  write with no host branching.
 - Host side: ``PageAllocator`` — a free list with ownership tracking and the
   scheduler invariants of SURVEY §5.2 enforced at every call: a page is
   owned by at most one sequence; double-free and foreign-free raise.
@@ -36,17 +45,21 @@ TRASH_PAGE = 0
 
 @dataclass
 class PagedKVCache:
-    """Device-side paged cache tensors (a pytree; leaves have leading L axis
-    so the model's ``lax.scan`` over layers slices one layer's pages)."""
+    """Device-side paged cache tensors (a pytree; the leading layer axis is
+    carried through the model's ``lax.scan`` and indexed per layer by the
+    kernels via scalar prefetch)."""
 
-    k_pages: Any  # [L, P, Hkv, page_size, head_dim]
-    v_pages: Any  # [L, P, Hkv, page_size, head_dim]
+    k_pages: Any  # [L, P, page_size, Hkv * head_dim]
+    v_pages: Any
     page_size: int
     num_pages: int
 
     @classmethod
     def create(cls, config: LlamaConfig, num_pages: int, page_size: int) -> "PagedKVCache":
-        shape = (config.n_layers, num_pages, config.n_kv_heads, page_size, config.head_dim)
+        shape = (
+            config.n_layers, num_pages, page_size,
+            config.n_kv_heads * config.head_dim,
+        )
         return cls(
             k_pages=jnp.zeros(shape, config.dtype),
             v_pages=jnp.zeros(shape, config.dtype),
@@ -55,7 +68,7 @@ class PagedKVCache:
         )
 
     def layers_pytree(self) -> tuple[Any, Any]:
-        """The (k, v) pair fed to the model forward as the scan-sliced cache."""
+        """The (k, v) pair carried through the model forward as the cache."""
         return (self.k_pages, self.v_pages)
 
     def hbm_bytes(self) -> int:
@@ -133,23 +146,30 @@ def pages_needed(n_tokens: int, page_size: int) -> int:
 
 
 def scatter_kv_chunk(
-    k_pages_layer: Any,  # [P, Hkv, page_size, hd] one layer's pages
-    v_pages_layer: Any,
+    k_pages: Any,  # [L, P, page_size, Hkv*hd] full-depth cache
+    v_pages: Any,
     k_new: Any,  # [B, C, Hkv, hd]
     v_new: Any,
     page_table: Any,  # [B, max_pages] int32 physical page ids (0 = trash)
     start_pos: Any,  # [B] int32 absolute position of chunk token 0
     n_valid: Any,  # [B] int32 how many of the C tokens are real
     page_size: int,
+    layer: Any,  # scalar int32 — which layer's pages to write
 ) -> tuple[Any, Any]:
-    """Scatter a chunk of new K/V into the paged layout (fixed shapes).
+    """Scatter a chunk of new K/V into one layer's pages (fixed shapes).
 
     Token (b, i) lands at absolute position ``start_pos[b] + i`` →
     logical page ``pos // page_size``, offset ``pos % page_size``, physical
     page ``page_table[b, logical]``. Padding lanes (i >= n_valid[b]) are
     redirected to the trash page.
+
+    This is the PREFILL write path (and the jnp reference path for decode):
+    an XLA scatter, which costs a full-cache copy per call — fine amortized
+    over a whole batched prefill chunk, ruinous per decode token. Decode
+    uses the in-place Pallas append (ops/kv_append.py) instead.
     """
     B, C = k_new.shape[:2]
+    hd_fused = k_pages.shape[-1]
     i = jnp.arange(C)[None, :]  # [1, C]
     pos = start_pos[:, None] + i  # [B, C]
     logical = pos // page_size
@@ -158,28 +178,33 @@ def scatter_kv_chunk(
     valid = i < n_valid[:, None]
     phys = jnp.where(valid, phys, TRASH_PAGE)
 
+    lay = jnp.broadcast_to(jnp.asarray(layer, jnp.int32), (B * C,))
     flat_phys = phys.reshape(-1)  # [B*C]
     flat_off = offset.reshape(-1)
-    # token (page, head, offset) destination; heads ride along as a slice
-    k_flat = k_new.reshape(B * C, *k_new.shape[2:])  # [B*C, Hkv, hd]
-    v_flat = v_new.reshape(B * C, *v_new.shape[2:])
-    k_pages_layer = k_pages_layer.at[flat_phys, :, flat_off].set(k_flat, mode="drop")
-    v_pages_layer = v_pages_layer.at[flat_phys, :, flat_off].set(v_flat, mode="drop")
-    return k_pages_layer, v_pages_layer
+    k_flat = k_new.reshape(B * C, hd_fused)  # token rows, heads fused
+    v_flat = v_new.reshape(B * C, hd_fused)
+    k_pages = k_pages.at[lay, flat_phys, flat_off].set(k_flat, mode="drop")
+    v_pages = v_pages.at[lay, flat_phys, flat_off].set(v_flat, mode="drop")
+    return k_pages, v_pages
 
 
 def gather_kv(
-    k_pages_layer: Any,  # [P, Hkv, page_size, hd]
-    v_pages_layer: Any,
+    k_pages: Any,  # [L, P, page_size, Hkv*hd]
+    v_pages: Any,
     page_table: Any,  # [B, max_pages]
     page_size: int,
+    layer: Any,  # scalar int32
+    n_kv: int,
 ) -> tuple[Any, Any]:
-    """Gather each sequence's pages into a contiguous [B, max_len, Hkv, hd]
-    view (max_len = max_pages * page_size). Reference path; the Pallas paged
-    kernel reads pages in place instead."""
+    """Gather one layer's pages for each sequence into a contiguous
+    [B, max_len, Hkv, hd] view (max_len = max_pages * page_size). Reference
+    path; the Pallas paged kernel reads pages in place instead."""
     B, max_pages = page_table.shape
-    k = k_pages_layer[page_table]  # [B, max_pages, Hkv, page_size, hd]
-    v = v_pages_layer[page_table]
-    k = k.transpose(0, 1, 3, 2, 4).reshape(B, max_pages * page_size, k.shape[2], k.shape[4])
-    v = v.transpose(0, 1, 3, 2, 4).reshape(B, max_pages * page_size, v.shape[2], v.shape[4])
+    k_l = jax.lax.dynamic_index_in_dim(k_pages, layer, 0, keepdims=False)
+    v_l = jax.lax.dynamic_index_in_dim(v_pages, layer, 0, keepdims=False)
+    k = k_l[page_table]  # [B, max_pages, page_size, Hkv*hd]
+    v = v_l[page_table]
+    T = max_pages * page_size
+    k = k.reshape(B, T, n_kv, k.shape[-1] // n_kv)
+    v = v.reshape(B, T, n_kv, v.shape[-1] // n_kv)
     return k, v

@@ -6,9 +6,13 @@ model replica:
 
 - Admission: pending sequences are admitted when a slot AND enough KV pages
   for prompt + max_new_tokens are available (no mid-flight OOM).
-- Chunked prefill interleaved with decode: each loop iteration runs at most
-  one prefill chunk, then one decode step for all active slots — long
-  prompts cannot starve in-flight decodes (SURVEY §7.3 hard part 3).
+- Batched chunked prefill interleaved with decode: each loop iteration runs
+  ONE prefill round — every prefilling sequence advances one chunk in a
+  single [N, chunk] ``prefill_step`` (N padded to a power of two, so at
+  most log2(max_seqs) compiled variants) — then one decode step for all
+  active slots. A 64-session burst costs a handful of weight-reads instead
+  of 64 serial ones, and long prompts cannot starve in-flight decodes
+  (SURVEY §7.3 hard part 3).
 - Pipelined decode (SURVEY §7.3 hard part 3, "low-latency token
   streaming"): decode step N+1 is dispatched to the device BEFORE step N's
   tokens are fetched, so the device never idles waiting for the host, and
@@ -204,45 +208,94 @@ class ContinuousBatchingScheduler:
         else:
             self._finish(handle, reason)
 
-    async def _prefill_one_chunk(self, handle: SequenceHandle) -> None:
-        inject("scheduler.prefill", seq_id=handle.seq_id)
+    async def _prefill_round(self) -> None:
+        """Advance EVERY currently-prefilling sequence one chunk in a single
+        batched ``prefill_step`` (one weights-read for the whole round). The
+        batch dim is padded to the next power of two so a burst of admissions
+        compiles at most log2(max_seqs) prefill variants, not one per N."""
         eng = self.engine
         C = eng.engine_cfg.prefill_chunk
-        chunk = handle.prompt_ids[handle.prefill_pos : handle.prefill_pos + C]
-        n_valid = len(chunk)
-        tokens = jnp.asarray(chunk + [0] * (C - n_valid), jnp.int32)[None, :]
-        eng.state, last_logits = prefill_step(
-            eng.params, eng.state, tokens,
-            jnp.int32(handle.slot), jnp.int32(handle.prefill_pos), jnp.int32(n_valid),
+        batch: list[SequenceHandle] = []
+        for handle in list(self.prefilling):
+            try:
+                inject("scheduler.prefill", seq_id=handle.seq_id)
+            except Exception as e:  # per-sequence isolation at injection
+                logger.error("prefill error for %s: %s", handle.seq_id, e)
+                self._evict(handle, "error", error=str(e))
+                continue
+            batch.append(handle)
+        if not batch:
+            return
+
+        N = 1
+        while N < len(batch):
+            N *= 2
+        tokens = np.zeros((N, C), np.int32)
+        slots = np.zeros((N,), np.int32)
+        starts = np.zeros((N,), np.int32)
+        n_valids = np.zeros((N,), np.int32)
+        slots[:] = batch[0].slot  # padding rows: n_valid 0 → trash writes
+        for i, handle in enumerate(batch):
+            chunk = handle.prompt_ids[handle.prefill_pos : handle.prefill_pos + C]
+            tokens[i, : len(chunk)] = chunk
+            slots[i] = handle.slot
+            starts[i] = handle.prefill_pos
+            n_valids[i] = len(chunk)
+        eng.state, logits = prefill_step(
+            eng.params, eng.state,
+            jnp.asarray(tokens), jnp.asarray(slots),
+            jnp.asarray(starts), jnp.asarray(n_valids),
             config=eng.config, page_size=eng.page_size,
             attn_backend=eng.attn_backend,
         )
-        handle.prefill_pos += n_valid
-        if handle.prefill_pos < len(handle.prompt_ids):
-            return  # more chunks to go; dispatch-only, no host sync needed
-        handle.span.mark("prefill_done")
-        s = handle.sampling
-        eng.state, token = commit_first_token(
-            eng.state, jnp.int32(handle.slot), last_logits,
-            jnp.float32(s.temperature), jnp.float32(s.top_p), jnp.int32(s.top_k),
-        )
-        if handle.constraint is not None:
-            logits_host = await asyncio.to_thread(np.asarray, last_logits)
-            if handle.finished:  # cancelled while fetching
-                return
-            token_id = handle.constraint.pick(
-                logits_host, s.temperature, self._rng,
-                remaining=s.max_new_tokens - handle.generated,
-                top_p=s.top_p, top_k=s.top_k,
+
+        finished: list[tuple[int, SequenceHandle]] = []
+        for i, handle in enumerate(batch):
+            handle.prefill_pos += int(n_valids[i])
+            if handle.prefill_pos >= len(handle.prompt_ids):
+                finished.append((i, handle))
+        if not finished:
+            return  # dispatch-only round, no host sync needed
+
+        tokens_dev = []
+        for row, h in finished:
+            h.span.mark("prefill_done")
+            s = h.sampling
+            eng.state, token = commit_first_token(
+                eng.state, jnp.int32(h.slot), logits[row],
+                jnp.float32(s.temperature), jnp.float32(s.top_p), jnp.int32(s.top_k),
             )
-            eng.set_last_token(handle.slot, token_id)
-        else:
-            token_id = int(await asyncio.to_thread(np.asarray, token))
-            if handle.finished:
-                return
-        self.prefilling.remove(handle)
-        self.decoding[handle.slot] = handle
-        self._deliver(handle, int(token_id))
+            tokens_dev.append(token)
+        # one host fetch for all completions (worker thread keeps loop live)
+        fetched, logit_rows = await asyncio.to_thread(
+            lambda: (
+                [int(np.asarray(t)) for t in tokens_dev],
+                {
+                    row: np.asarray(logits[row])
+                    for (row, h) in finished
+                    if h.constraint is not None
+                },
+            )
+        )
+        for (row, handle), token_id in zip(finished, fetched):
+            if handle.finished:  # cancelled while fetching
+                continue
+            try:
+                s = handle.sampling
+                if handle.constraint is not None:
+                    token_id = handle.constraint.pick(
+                        logit_rows[row], s.temperature, self._rng,
+                        remaining=s.max_new_tokens - handle.generated,
+                        top_p=s.top_p, top_k=s.top_k,
+                    )
+                    eng.set_last_token(handle.slot, token_id)
+                self.prefilling.remove(handle)
+                self.decoding[handle.slot] = handle
+                self._deliver(handle, int(token_id))
+            except Exception as e:  # per-sequence isolation (host-side pick
+                # or delivery error must not fail the other sequences)
+                logger.error("prefill completion error for %s: %s", handle.seq_id, e)
+                self._evict(handle, "error", error=str(e))
 
     def _deliver(self, handle: SequenceHandle, token_id: int) -> None:
         handle._emit_first_token_metrics()
@@ -333,15 +386,18 @@ class ContinuousBatchingScheduler:
 
             self._admit()
 
-            # one prefill chunk, interleaved with decode so TTFT work cannot
+            # one batched prefill round (all prefilling sequences advance a
+            # chunk together), interleaved with decode so TTFT work cannot
             # starve in-flight streams
             if self.prefilling:
-                handle = self.prefilling[0]
                 try:
-                    await self._prefill_one_chunk(handle)
-                except Exception as e:  # per-sequence isolation
-                    logger.error("prefill error for %s: %s", handle.seq_id, e)
-                    self._evict(handle, "error", error=str(e))
+                    await self._prefill_round()
+                except Exception as e:
+                    # a whole-round failure is not attributable to one
+                    # sequence: fail everything in the round, keep serving
+                    logger.error("prefill round error: %s", e)
+                    for handle in list(self.prefilling):
+                        self._evict(handle, "error", error=str(e))
 
             if self.decoding:
                 try:

@@ -164,21 +164,30 @@ def forward(
     *,
     config: LlamaConfig,
     attention: AttentionFn,
-    cache: Any = None,  # pytree whose leaves have leading axis n_layers, or None
+    cache: Any = None,  # full-depth cache pytree (carried), or None
     remat: bool = False,  # checkpoint each scanned layer (training)
 ) -> tuple[Array, Any]:
-    """Run the decoder; returns (logits[B,S,vocab] fp32, new_cache)."""
+    """Run the decoder; returns (logits[B,S,vocab] fp32, new_cache).
+
+    The cache rides the layer scan as part of the CARRY and the attention
+    callback receives the whole cache plus the layer index (kernels index
+    the layer via scalar prefetch). The alternative — slicing the cache as
+    scan xs and restacking updates as ys — forces XLA to write a fresh
+    full-cache buffer every step (~22 ms/step measured for a 1.5 GB cache,
+    benchmarks/probe_cache_styles.py); carrying it lets the in-place Pallas
+    writers (ops/kv_append.py) keep the buffer aliased end to end.
+    """
     c = config
     x = params["embed"][tokens]  # [B,S,D]
 
     def scan_body(carry, scanned):
-        x = carry
-        layer_params, layer_cache, layer_idx = scanned
-        x, new_layer_cache = _layer(
-            x, layer_params, layer_cache, layer_idx,
+        x, cache = carry
+        layer_params, layer_idx = scanned
+        x, cache = _layer(
+            x, layer_params, cache, layer_idx,
             positions=positions, config=c, attention=attention,
         )
-        return x, new_layer_cache
+        return (x, cache), None
 
     if remat:
         # per-layer remat: backward recomputes one layer at a time, so live
@@ -186,11 +195,7 @@ def forward(
         scan_body = jax.checkpoint(scan_body)
 
     layer_ids = jnp.arange(c.n_layers)
-    cacheless = cache is None
-    cache_xs = jnp.zeros((c.n_layers,), jnp.int32) if cacheless else cache
-    x, new_cache = lax.scan(scan_body, x, (params["layers"], cache_xs, layer_ids))
-    if cacheless:
-        new_cache = None
+    (x, new_cache), _ = lax.scan(scan_body, (x, cache), (params["layers"], layer_ids))
 
     x = rms_norm(x, params["norm"], c.norm_eps)
     head = params["embed"].T if c.tie_embeddings else params["lm_head"]

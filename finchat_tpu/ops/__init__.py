@@ -1,5 +1,6 @@
 from finchat_tpu.ops.dispatch import attention_backend, causal_attention, paged_attention
 from finchat_tpu.ops.flash_attention import flash_attention
+from finchat_tpu.ops.kv_append import paged_kv_append
 from finchat_tpu.ops.paged_attention import paged_flash_attention
 from finchat_tpu.ops.refs import gqa_repeat, mha_reference
 
@@ -11,4 +12,5 @@ __all__ = [
     "mha_reference",
     "paged_attention",
     "paged_flash_attention",
+    "paged_kv_append",
 ]

@@ -17,6 +17,7 @@ from __future__ import annotations
 import os
 
 import jax
+import jax.numpy as jnp
 from jax import Array
 
 from finchat_tpu.utils.logging import get_logger
@@ -41,13 +42,15 @@ def attention_backend() -> str:
 
 def paged_attention(
     q: Array,  # [B, C, H, D]
-    k_pages: Array,  # [P, Hkv, page_size, D]
+    k_pages: Array,  # [L, P, page_size, Hkv*D] — full-depth cache
     v_pages: Array,
     page_table: Array,  # [B, max_pages]
     q_offset: Array,  # [B]
     kv_len: Array,  # [B]
+    layer: Array,  # [1] int32 — which layer's pages to read
     *,
     page_size: int,
+    n_kv: int,
     backend: str | None = None,
 ) -> Array:
     """Paged-KV attention via the requested (or default) backend."""
@@ -56,15 +59,19 @@ def paged_attention(
         from finchat_tpu.engine.kv_cache import gather_kv
         from finchat_tpu.ops.refs import mha_reference
 
-        k_all, v_all = gather_kv(k_pages, v_pages, page_table, page_size)
+        k_all, v_all = gather_kv(
+            k_pages, v_pages, page_table, page_size,
+            jnp.asarray(layer, jnp.int32).reshape(()), n_kv,
+        )
         return mha_reference(
             q, k_all, v_all, causal=True, q_offset=q_offset, kv_len=kv_len
         )
     from finchat_tpu.ops.paged_attention import paged_flash_attention
 
     return paged_flash_attention(
-        q, k_pages, v_pages, page_table, q_offset, kv_len,
-        page_size=page_size, interpret=(backend == "pallas-interpret"),
+        q, k_pages, v_pages, page_table, q_offset, kv_len, layer,
+        page_size=page_size, n_kv=n_kv,
+        interpret=(backend == "pallas-interpret"),
     )
 
 

@@ -9,18 +9,29 @@ place** via a scalar-prefetched page table, so per-step HBM traffic is
 exactly the live KV bytes (ragged per sequence), with Pallas double-buffering
 the page DMAs behind the MXU work.
 
+Cache layout: ``[n_layers, P, page_size, Hkv*hd]`` — token-major pages,
+heads fused into the minor dim (see engine/kv_cache.py for why). The kernel
+takes the FULL-depth cache plus a scalar-prefetched layer index, because the
+cache rides the model's layer scan as a carry; slicing one layer out with
+XLA would copy it.
+
 Design:
-- grid ``(B, Hkv, nq, max_pages)`` — page axis innermost; online-softmax
-  state (m, l, acc) carries across a sequence's pages in VMEM scratch.
+- grid ``(B, nq, max_pages)`` — page axis innermost; online-softmax state
+  (m, l, acc) carries across a sequence's pages in VMEM scratch. All KV
+  heads are processed in ONE program (a static inner unroll): TPU grid
+  iterations cost ~1 µs each, and a per-(kv-head) grid axis multiplied the
+  count by Hkv — ~30 ms/step of pure grid overhead at TinyLlama bench
+  shapes (measured round 4, benchmarks/profile_decode.py).
+- per-head K/V tiles are VALUE slices ``k_blk[:, h*hd:(h+1)*hd]`` of the
+  loaded ``(page_size, Hkv*hd)`` block — in-kernel value slicing is exempt
+  from Mosaic's DMA tile-alignment rules.
 - the K/V BlockSpec index map resolves ``page_table[b, p]`` at DMA time
   (PrefetchScalarGridSpec); pages that are causally skippable or past
   ``kv_len[b]`` are redirected to the trash page (physical page 0, the same
-  page the cache scatter parks padding writes in — engine/kv_cache.py), and
-  consecutive identical block indices are not re-fetched by the pipeline.
-- pages are head-major ``[P, Hkv, page_size, head_dim]`` so one (page,
-  kv-head) DMA is a contiguous Mosaic-tileable (page_size, head_dim) tile.
-- GQA: one program per KV head; its ``group = H // Hkv`` query heads ride in
-  the same block, so each page's K/V slice is fetched once total.
+  page the writers park padding in), and consecutive identical block
+  indices are not re-fetched by the pipeline.
+- GQA: each kv head's ``group = H // Hkv`` query heads ride in the same
+  q block, so each page is fetched once per (b, q-block).
 
 Serves both decode (C = 1) and paged chunked prefill (C = chunk) — the same
 causal/ragged masking as ``ops.refs.mha_reference`` with ``q_offset``/
@@ -39,7 +50,6 @@ from jax.experimental.pallas import tpu as pltpu
 
 from finchat_tpu.ops.flash_attention import (
     NEG_INF,
-    _online_softmax_update,
     _pick_block,
     _round_up,
 )
@@ -49,14 +59,15 @@ TRASH_PAGE = 0
 
 def _paged_kernel(
     # scalar prefetch
+    layer_ref,  # [1] int32
     page_table_ref,  # [B, max_pages] int32 in SMEM
     q_offset_ref,  # [B] int32
     kv_len_ref,  # [B] int32
-    # blocks (head-major)
-    q_ref,  # [1, G, Bq, D]
-    k_ref,  # [1, 1, page_size, D] — one physical page, one KV head
+    # blocks
+    q_ref,  # [1, H, Bq, D]
+    k_ref,  # [1, 1, page_size, Hkv*D] — one physical page
     v_ref,
-    o_ref,  # [1, G, Bq, D]
+    o_ref,  # [1, H, Bq, D]
     # scratch
     m_scr,  # [Rpad, 128] fp32
     l_scr,
@@ -64,16 +75,18 @@ def _paged_kernel(
     *,
     block_q: int,
     page_size: int,
+    n_kv: int,
     group: int,
     scale: float,
 ):
     b = pl.program_id(0)
-    qi = pl.program_id(2)
-    p = pl.program_id(3)
-    n_pages = pl.num_programs(3)
+    qi = pl.program_id(1)
+    p = pl.program_id(2)
+    n_pages = pl.num_programs(2)
 
     Bq = block_q
-    R = group * Bq
+    D = q_ref.shape[-1]
+    Rh = group * Bq  # scratch rows per kv head
     q_off = q_offset_ref[b]
     kv_len = kv_len_ref[b]
 
@@ -89,43 +102,64 @@ def _paged_kernel(
 
     @pl.when(needed)
     def _accumulate():
-        q_blk = q_ref[0].reshape(R, q_ref.shape[3])  # row r = head r//Bq, pos r%Bq
-        k_blk = k_ref[0, 0]  # [page_size, D]
-        v_blk = v_ref[0, 0]
-
-        rows = jax.lax.broadcasted_iota(jnp.int32, (R, page_size), 0)
-        cols = jax.lax.broadcasted_iota(jnp.int32, (R, page_size), 1)
+        rows = jax.lax.broadcasted_iota(jnp.int32, (Rh, page_size), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (Rh, page_size), 1)
         q_pos = q_off + qi * Bq + rows % Bq
         kv_pos = page_start + cols
         invalid = jnp.logical_or(kv_pos >= kv_len, kv_pos > q_pos)
 
-        m_new, l_new, acc_new = _online_softmax_update(
-            q_blk, k_blk, v_blk, invalid,
-            m_scr[:R, :1], l_scr[:R, :1], acc_scr[:R], scale,
-        )
-        m_scr[:R, :1] = m_new
-        l_scr[:R, :1] = l_new
-        acc_scr[:R] = acc_new
+        for h in range(n_kv):  # static unroll over kv heads
+            # row r = (query head h*group + r // Bq), position r % Bq
+            q_blk = q_ref[0, h * group:(h + 1) * group].reshape(Rh, D)
+            k_blk = k_ref[0, 0, :, h * D:(h + 1) * D]  # [PS, D] value slice
+            v_blk = v_ref[0, 0, :, h * D:(h + 1) * D]
+            r0 = h * Rh
+
+            s = jax.lax.dot_general(
+                q_blk, k_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale
+            s = jnp.where(invalid, NEG_INF, s)
+            m_prev = m_scr[r0:r0 + Rh, :1]
+            l_prev = l_scr[r0:r0 + Rh, :1]
+            m_cur = jnp.max(s, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            # explicit zeroing: rows whose every logit is masked have
+            # m_new = NEG_INF and exp(s - m_new) = 1 there — the mask, not
+            # the exp, must decide
+            pr = jnp.where(invalid, 0.0, jnp.exp(s - m_new))
+            corr = jnp.exp(m_prev - m_new)
+            l_new = l_prev * corr + jnp.sum(pr, axis=-1, keepdims=True)
+            acc_new = acc_scr[r0:r0 + Rh] * corr + jax.lax.dot_general(
+                pr.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            m_scr[r0:r0 + Rh, :1] = m_new
+            l_scr[r0:r0 + Rh, :1] = l_new
+            acc_scr[r0:r0 + Rh] = acc_new
 
     @pl.when(p == n_pages - 1)
     def _finalize():
+        R = n_kv * Rh
         out = acc_scr[:R] / jnp.maximum(l_scr[:R, :1], 1e-30)
-        o_ref[0] = out.reshape(group, Bq, -1).astype(o_ref.dtype)
+        o_ref[0] = out.reshape(n_kv * group, Bq, D).astype(o_ref.dtype)
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("page_size", "scale", "block_q", "interpret"),
+    static_argnames=("page_size", "n_kv", "scale", "block_q", "interpret"),
 )
 def paged_flash_attention(
     q: Array,  # [B, C, H, D] — C = 1 for decode, chunk size for prefill
-    k_pages: Array,  # [P, Hkv, page_size, D] — one layer's pages, in place
+    k_pages: Array,  # [L, P, page_size, Hkv*D] — full-depth cache, in place
     v_pages: Array,
     page_table: Array,  # [B, max_pages] int32 physical page ids (0 = trash)
     q_offset: Array,  # [B] int32 — absolute position of q[:, 0]
     kv_len: Array,  # [B] int32 — valid KV length incl. this chunk's tokens
+    layer: Array,  # [1] int32 — which layer's pages to read
     *,
     page_size: int,
+    n_kv: int,
     scale: float | None = None,
     block_q: int = 128,
     interpret: bool | None = None,
@@ -134,15 +168,15 @@ def paged_flash_attention(
 
     Causal with absolute positions (query row i of batch b is at
     ``q_offset[b] + i``); sequences with ``kv_len == 0`` produce zeros.
-    The current chunk's K/V must already be scattered into the pages
-    (engine/kv_cache.py ``scatter_kv_chunk`` runs first).
+    The current chunk's K/V must already be in the pages (the decode append
+    kernel or the prefill scatter runs first).
     """
     B, C, H, D = q.shape
-    Hkv = k_pages.shape[1]
     max_pages = page_table.shape[1]
-    assert H % Hkv == 0, (H, Hkv)
+    assert H % n_kv == 0, (H, n_kv)
     assert k_pages.shape[2] == page_size, (k_pages.shape, page_size)
-    group = H // Hkv
+    assert k_pages.shape[3] == n_kv * D, (k_pages.shape, n_kv, D)
+    group = H // n_kv
     scale = scale if scale is not None else D ** -0.5
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -150,14 +184,15 @@ def paged_flash_attention(
     q_offset = jnp.asarray(q_offset, jnp.int32)
     kv_len = jnp.asarray(kv_len, jnp.int32)
     page_table = jnp.asarray(page_table, jnp.int32)
+    layer = jnp.asarray(layer, jnp.int32)
 
     bq = _pick_block(C, block_q)
     nq = C // bq
-    r_pad = _round_up(max(group * bq, 8), 8)
+    r_pad = _round_up(max(H * bq, 8), 8)
 
     q_t = q.transpose(0, 2, 1, 3)  # [B, H, C, D]
 
-    def kv_index(b, h, qi, p, page_table_ref, q_offset_ref, kv_len_ref):
+    def kv_index(b, qi, p, layer_ref, page_table_ref, q_offset_ref, kv_len_ref):
         # resolve logical page -> physical page at DMA time; redirect pages
         # that contribute nothing to the trash page (repeat fetches of the
         # same block index are skipped by the pipeline)
@@ -165,17 +200,17 @@ def paged_flash_attention(
         q_max = q_offset_ref[b] + (qi + 1) * bq - 1
         needed = jnp.logical_and(page_start < kv_len_ref[b], page_start <= q_max)
         phys = jnp.where(needed, page_table_ref[b, p], TRASH_PAGE)
-        return (phys, h, 0, 0)
+        return (layer_ref[0], phys, 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
-        grid=(B, Hkv, nq, max_pages),
+        num_scalar_prefetch=4,
+        grid=(B, nq, max_pages),
         in_specs=[
-            pl.BlockSpec((1, group, bq, D), lambda b, h, qi, p, *_: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, page_size, D), kv_index),
-            pl.BlockSpec((1, 1, page_size, D), kv_index),
+            pl.BlockSpec((1, H, bq, D), lambda b, qi, p, *_: (b, 0, qi, 0)),
+            pl.BlockSpec((1, 1, page_size, n_kv * D), kv_index),
+            pl.BlockSpec((1, 1, page_size, n_kv * D), kv_index),
         ],
-        out_specs=pl.BlockSpec((1, group, bq, D), lambda b, h, qi, p, *_: (b, h, qi, 0)),
+        out_specs=pl.BlockSpec((1, H, bq, D), lambda b, qi, p, *_: (b, 0, qi, 0)),
         scratch_shapes=[
             pltpu.VMEM((r_pad, 128), jnp.float32),
             pltpu.VMEM((r_pad, 128), jnp.float32),
@@ -184,12 +219,12 @@ def paged_flash_attention(
     )
     kernel = functools.partial(
         _paged_kernel,
-        block_q=bq, page_size=page_size, group=group, scale=scale,
+        block_q=bq, page_size=page_size, n_kv=n_kv, group=group, scale=scale,
     )
     out_t = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, C, D), q.dtype),
         interpret=interpret,
-    )(page_table, q_offset, kv_len, q_t, k_pages, v_pages)
+    )(layer, page_table, q_offset, kv_len, q_t, k_pages, v_pages)
     return out_t.transpose(0, 2, 1, 3)

@@ -65,9 +65,12 @@ def decode_state_shardings(mesh: Mesh) -> dict[str, Any]:
         return NamedSharding(mesh, P(*spec))
 
     return {
-        # [L, pages, Hkv, page_size, hd] — KV heads on the model axis
-        "k_pages": ns(None, None, "model", None, None),
-        "v_pages": ns(None, None, "model", None, None),
+        # [L, pages, page_size, Hkv*hd] — the fused KV-head dim on the model
+        # axis (head-major within the fused dim, so a model-axis shard is a
+        # whole number of KV heads — matching the k/v projection sharding,
+        # keeping cache writes local)
+        "k_pages": ns(None, None, None, "model"),
+        "v_pages": ns(None, None, None, "model"),
         "page_table": ns(None, None),
         "context_lens": ns(None),
         "last_tokens": ns(None),

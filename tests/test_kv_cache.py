@@ -61,39 +61,43 @@ def test_free_then_realloc_keeps_invariants():
 
 
 def test_scatter_gather_roundtrip():
-    P, ps, Hkv, hd = 6, 4, 2, 8
-    k_pages = jnp.zeros((P, Hkv, ps, hd))
-    v_pages = jnp.zeros((P, Hkv, ps, hd))
+    L, P, ps, Hkv, hd = 2, 6, 4, 2, 8
+    k_pages = jnp.zeros((L, P, ps, Hkv * hd))
+    v_pages = jnp.zeros((L, P, ps, Hkv * hd))
     B, C = 1, 6
     k_new = jnp.arange(B * C * Hkv * hd, dtype=jnp.float32).reshape(B, C, Hkv, hd)
     v_new = -k_new
     page_table = jnp.asarray([[2, 4, 0]], jnp.int32)  # logical pages 0,1 -> phys 2,4
-    # write 6 tokens starting at absolute position 2: positions 2,3 in page 2,
-    # positions 4..7 in page 4
+    # write 6 tokens starting at absolute position 2 into layer 1: positions
+    # 2,3 in page 2, positions 4..7 in page 4
     k_pages, v_pages = scatter_kv_chunk(
         k_pages, v_pages, k_new, v_new, page_table,
         start_pos=jnp.asarray([2]), n_valid=jnp.asarray([6]), page_size=ps,
+        layer=jnp.int32(1),
     )
-    k_all, v_all = gather_kv(k_pages, v_pages, page_table, ps)
+    k_all, v_all = gather_kv(k_pages, v_pages, page_table, ps, jnp.int32(1), Hkv)
     assert k_all.shape == (B, 3 * ps, Hkv, hd)
     # gathered positions 2..7 must equal the chunk in order
     assert jnp.array_equal(k_all[0, 2:8], k_new[0])
     assert jnp.array_equal(v_all[0, 2:8], v_new[0])
     # trash page (phys 0) is untouched territory for this row's logical page 2
     assert jnp.array_equal(k_all[0, 8:], jnp.zeros((ps, Hkv, hd)))
+    # the other layer is untouched
+    assert float(jnp.abs(k_pages[0]).sum()) == 0.0
 
 
 def test_scatter_padding_goes_to_trash():
-    P, ps, Hkv, hd = 4, 4, 1, 2
-    k_pages = jnp.zeros((P, Hkv, ps, hd))
-    v_pages = jnp.zeros((P, Hkv, ps, hd))
+    L, P, ps, Hkv, hd = 1, 4, 4, 1, 2
+    k_pages = jnp.zeros((L, P, ps, Hkv * hd))
+    v_pages = jnp.zeros((L, P, ps, Hkv * hd))
     k_new = jnp.ones((1, 4, Hkv, hd))
     page_table = jnp.asarray([[1, 2]], jnp.int32)
     k_pages, v_pages = scatter_kv_chunk(
         k_pages, v_pages, k_new, k_new, page_table,
         start_pos=jnp.asarray([0]), n_valid=jnp.asarray([2]), page_size=ps,
+        layer=jnp.int32(0),
     )
     # only 2 valid tokens written to page 1; padding went to trash page 0
-    assert float(k_pages[1, :, :2].sum()) == 2 * Hkv * hd
-    assert float(k_pages[1, :, 2:].sum()) == 0.0
-    assert float(k_pages[2].sum()) == 0.0
+    assert float(k_pages[0, 1, :2].sum()) == 2 * Hkv * hd
+    assert float(k_pages[0, 1, 2:].sum()) == 0.0
+    assert float(k_pages[0, 2].sum()) == 0.0
