@@ -148,21 +148,38 @@ def measure(preset: str, batch: int, prompt_len: int, steps: int, warmup: int,
 
     # assign pages + prefill a random prompt into every slot — all slots
     # batched into one prefill_step round (one weights-read per chunk round
-    # for the WHOLE batch; the round-3 serial path took 8.6 s for 64x128)
+    # for the WHOLE batch; the round-3 serial path took 8.6 s for 64x128).
+    # A throwaway warmup round triggers the one-time XLA compile (serving
+    # pays it at startup via Engine.warmup, not per request), then slots are
+    # reset and the steady-state prefill is timed.
     rng = np.random.default_rng(0)
-    next_page = 1  # page 0 is the trash page
-    t_prefill0 = time.perf_counter()
-    items = []
-    for slot in range(batch):
-        engine.set_page_table_row(slot, list(range(next_page, next_page + pages_per_seq)))
-        next_page += pages_per_seq
-        prompt = rng.integers(1, config.vocab_size, size=prompt_len).tolist()
-        items.append((slot, prompt))
+    rows = {
+        slot: list(range(1 + slot * pages_per_seq, 1 + (slot + 1) * pages_per_seq))
+        for slot in range(batch)
+    }
+    engine.set_page_table_rows(rows)
+    items = [
+        (slot, rng.integers(1, config.vocab_size, size=prompt_len).tolist())
+        for slot in range(batch)
+    ]
+    t_compile0 = time.perf_counter()
     engine.prefill_batch(items)
     np.asarray(engine.state.context_lens)  # host fetch = execution barrier
+    prefill_compile_s = time.perf_counter() - t_compile0
+    engine.reset_slots(list(rows))
+    engine.set_page_table_rows(rows)
+    # barrier on BOTH updated arrays: reset must not leak into the timed
+    # region (dependent device->host copies are the only reliable barrier
+    # on the tunnel backend)
+    np.asarray(engine.state.context_lens)
+    np.asarray(engine.state.page_table.ravel()[:1])
+    t_prefill0 = time.perf_counter()
+    engine.prefill_batch(items)
+    np.asarray(engine.state.context_lens)
     prefill_s = time.perf_counter() - t_prefill0
-    print(f"[bench] prefill {batch}x{prompt_len} in {prefill_s:.1f}s "
-          f"(attn={attn})", file=sys.stderr, flush=True)
+    print(f"[bench] prefill {batch}x{prompt_len} in {prefill_s:.2f}s "
+          f"(first-call incl. compile {prefill_compile_s:.1f}s, attn={attn})",
+          file=sys.stderr, flush=True)
 
     active = jnp.ones((batch,), bool)
     temperature = jnp.full((batch,), 0.5, jnp.float32)
@@ -206,6 +223,8 @@ def measure(preset: str, batch: int, prompt_len: int, steps: int, warmup: int,
         "decode_steps": steps,
         "step_ms": round(1000 * elapsed / steps, 2),
         "prefill_s": round(prefill_s, 2),
+        "prefill_tok_s": round(batch * prompt_len / prefill_s, 1),
+        "prefill_compile_s": round(prefill_compile_s, 1),
         "platform": jax.devices()[0].platform,
         "device": str(jax.devices()[0]),
     }

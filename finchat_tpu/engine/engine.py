@@ -266,10 +266,21 @@ class InferenceEngine:
 
     # --- low-level ops used by the scheduler ----------------------------
     def set_page_table_row(self, slot: int, pages: list[int]) -> None:
-        row = jnp.zeros((self.max_pages_per_seq,), jnp.int32)
-        row = row.at[: len(pages)].set(jnp.asarray(pages, jnp.int32))
+        self.set_page_table_rows({slot: pages})
+
+    def set_page_table_rows(self, rows: dict[int, list[int]]) -> None:
+        """Assign several slots' page lists in ONE device update. Eager
+        ``.at[].set`` ops cost ~15 ms each through a remote-tunnel backend
+        (measured, round 4) — per-slot loops at batch 64 turn into seconds."""
+        import numpy as np
+
+        idx = np.asarray(list(rows), np.int32)
+        mat = np.zeros((len(rows), self.max_pages_per_seq), np.int32)
+        for i, pages in enumerate(rows.values()):
+            mat[i, : len(pages)] = pages
         self.state = dataclasses.replace(
-            self.state, page_table=self.state.page_table.at[slot].set(row)
+            self.state,
+            page_table=self.state.page_table.at[jnp.asarray(idx)].set(jnp.asarray(mat)),
         )
 
     def set_last_token(self, slot: int, token: int) -> None:
@@ -280,11 +291,17 @@ class InferenceEngine:
         )
 
     def reset_slot(self, slot: int) -> None:
+        self.reset_slots([slot])
+
+    def reset_slots(self, slots: list[int]) -> None:
+        """Clear several slots in one device update (see set_page_table_rows
+        for why batching matters)."""
+        idx = jnp.asarray(slots, jnp.int32)
         self.state = dataclasses.replace(
             self.state,
-            page_table=self.state.page_table.at[slot].set(0),
-            context_lens=self.state.context_lens.at[slot].set(0),
-            last_tokens=self.state.last_tokens.at[slot].set(0),
+            page_table=self.state.page_table.at[idx].set(0),
+            context_lens=self.state.context_lens.at[idx].set(0),
+            last_tokens=self.state.last_tokens.at[idx].set(0),
         )
 
     def prefill_batch(self, items: list[tuple[int, list[int]]]) -> list[Array]:
