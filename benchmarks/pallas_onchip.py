@@ -1,0 +1,66 @@
+"""Run the Pallas kernel parity matrix ON-CHIP and record the result.
+
+The round-3 verdict's missing artifact: tests/test_pallas_attention.py
+asserts pallas == jnp-oracle numerics, but before round 4 it had only ever
+run in interpret mode on CPU. Under ``FINCHAT_TESTS_TPU=1`` (conftest.py)
+the same matrix compiles with Mosaic and executes on the real TPU with
+``interpret=False``.
+
+Usage:  python benchmarks/pallas_onchip.py [out.json]
+Writes a JSON record {platform, device, tests, passed, failed, duration_s}.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import subprocess
+import sys
+import time
+
+
+def main() -> int:
+    out_path = sys.argv[1] if len(sys.argv) > 1 else "PALLAS_ONCHIP.json"
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/test_pallas_attention.py", "-q"],
+        env={**__import__("os").environ, "FINCHAT_TESTS_TPU": "1"},
+        capture_output=True, text=True, timeout=900,
+    )
+    duration = time.perf_counter() - t0
+    tail = (proc.stdout or "").strip().splitlines()[-1] if proc.stdout else ""
+    m = re.search(r"(\d+) passed", tail)
+    passed = int(m.group(1)) if m else 0
+    m = re.search(r"(\d+) failed", tail)
+    failed = int(m.group(1)) if m else 0
+
+    # confirm the backend really was TPU (interpret=False path)
+    probe = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; d = jax.devices()[0]; print(d.platform + '|' + str(d))"],
+        capture_output=True, text=True, timeout=120,
+    )
+    platform, _, device = (probe.stdout or "").strip().rpartition("\n")[2].partition("|")
+
+    record = {
+        "artifact": "pallas_onchip_parity",
+        "platform": platform,
+        "device": device,
+        "interpret": platform != "tpu",
+        "tests": passed + failed,
+        "passed": passed,
+        "failed": failed,
+        "rc": proc.returncode,
+        "duration_s": round(duration, 1),
+        "suite": "tests/test_pallas_attention.py (flash + paged attention + kv_append vs jnp oracles)",
+        "summary_line": tail,
+    }
+    with open(out_path, "w") as f:
+        json.dump(record, f, indent=2)
+        f.write("\n")
+    print(json.dumps(record))
+    return 0 if proc.returncode == 0 and platform == "tpu" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

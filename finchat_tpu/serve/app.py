@@ -110,6 +110,8 @@ def build_generators(cfg: AppConfig) -> tuple[TextGenerator, TextGenerator, Cont
     n_mesh = jax.device_count() if -1 in sizes else fixed
     mesh = build_mesh(spec, devices=jax.devices()[:n_mesh]) if n_mesh > 1 else None
     engine = InferenceEngine(config, params, cfg.engine, mesh=mesh)
+    if cfg.engine.warmup_on_start:
+        engine.warmup()
     scheduler = ContinuousBatchingScheduler(engine, eos_id=tokenizer.eos_id)
     generator = EngineGenerator(scheduler, tokenizer)
     return generator, generator, scheduler, tokenizer

@@ -159,6 +159,9 @@ class EngineConfig:
     top_k: int = 0
     watchdog_seconds: float = 100.0  # reference main.py:138
     stream_flush_tokens: int = 1  # tokens per outbound chunk
+    # compile every serving step variant at startup so the first request
+    # never pays XLA compilation inside the watchdog window
+    warmup_on_start: bool = True
 
 
 @dataclass
