@@ -43,6 +43,16 @@ from finchat_tpu.utils.logging import get_logger
 logger = get_logger(__name__)
 
 
+def round_up_pow2(n: int) -> int:
+    """The batch/shape padding policy shared by the scheduler's prefill
+    rounds, warmup's variant enumeration, and ring-prefill length buckets —
+    ONE rule so startup warmup always covers what serving dispatches."""
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
 @jax.tree_util.register_dataclass
 @dataclass
 class DecodeState:
@@ -84,6 +94,8 @@ def _paged_attention_fn(
     interpret = True if attn_backend == "pallas-interpret" else None
 
     def attention(q: Array, k: Array, v: Array, cache: Any, layer_idx: Array):
+        from finchat_tpu.utils.tracing import named_scope
+
         k_pages, v_pages = cache
         B, C = k.shape[:2]
         layer = layer_idx.reshape(1)
@@ -91,24 +103,27 @@ def _paged_attention_fn(
             # decode: in-place single-page RMW append (no cache copy)
             from finchat_tpu.ops.kv_append import paged_kv_append
 
-            kv_new = jnp.concatenate(
-                [k.reshape(B, 1, -1), v.reshape(B, 1, -1)], axis=-1
-            )
-            k_pages, v_pages = paged_kv_append(
-                kv_new, k_pages, v_pages, page_table, start_pos, n_valid,
-                layer, page_size=page_size, interpret=interpret,
-            )
+            with named_scope("kv_append"):
+                kv_new = jnp.concatenate(
+                    [k.reshape(B, 1, -1), v.reshape(B, 1, -1)], axis=-1
+                )
+                k_pages, v_pages = paged_kv_append(
+                    kv_new, k_pages, v_pages, page_table, start_pos, n_valid,
+                    layer, page_size=page_size, interpret=interpret,
+                )
         else:
             # prefill chunk (or jnp reference path): XLA scatter — one
             # cache copy amortized over the whole batched chunk
-            k_pages, v_pages = scatter_kv_chunk(
-                k_pages, v_pages, k, v, page_table, start_pos, n_valid,
-                page_size, layer_idx,
+            with named_scope("kv_scatter"):
+                k_pages, v_pages = scatter_kv_chunk(
+                    k_pages, v_pages, k, v, page_table, start_pos, n_valid,
+                    page_size, layer_idx,
+                )
+        with named_scope("paged_attention"):
+            out = paged_attention(
+                q, k_pages, v_pages, page_table, start_pos, start_pos + n_valid,
+                layer, page_size=page_size, n_kv=n_kv, backend=attn_backend,
             )
-        out = paged_attention(
-            q, k_pages, v_pages, page_table, start_pos, start_pos + n_valid,
-            layer, page_size=page_size, n_kv=n_kv, backend=attn_backend,
-        )
         return out, (k_pages, v_pages)
 
     return attention
@@ -150,6 +165,70 @@ def prefill_step(
         k_pages=k_pages,
         v_pages=v_pages,
         context_lens=state.context_lens.at[slots].add(n_valid),
+    )
+    return new_state, last_logits
+
+
+def _ring_prefill_attention_fn(mesh, page_table: Array, start_pos: Array, n_valid: Array, page_size: int):
+    """Attention callback for the seq-sharded long-prompt prefill: ring
+    attention over the ``seq`` mesh axis for the compute, XLA scatter for
+    the cache write (one cache copy amortized over the WHOLE prompt)."""
+    from finchat_tpu.ops.ring_attention import ring_attention
+
+    def attention(q: Array, k: Array, v: Array, cache: Any, layer_idx: Array):
+        k_pages, v_pages = cache
+        out = ring_attention(
+            q, k, v, mesh=mesh, axis="seq", head_axis="model", causal=True
+        )
+        k_pages, v_pages = scatter_kv_chunk(
+            k_pages, v_pages, k, v, page_table, start_pos, n_valid,
+            page_size, layer_idx,
+        )
+        return out, (k_pages, v_pages)
+
+    return attention
+
+
+@partial(jax.jit, static_argnames=("config", "page_size", "mesh"), donate_argnums=(1,))
+def ring_prefill_step(
+    params: dict[str, Any],
+    state: DecodeState,
+    tokens: Array,  # [1, S] — the WHOLE prompt, padded to a seq-axis multiple
+    slot: Array,  # scalar int32
+    n_valid: Array,  # scalar int32 — real prompt tokens
+    *,
+    config: LlamaConfig,
+    page_size: int,
+    mesh,
+) -> tuple[DecodeState, Array]:
+    """Seq-sharded single-shot prefill for long RAG prompts (SURVEY §5.7c).
+
+    The sequence dim is sharded over the mesh's ``seq`` axis: activations
+    and attention state are O(S / seq) per device, with K/V blocks rotating
+    the ICI ring (ops/ring_attention.py) — prompts beyond one chip's HBM
+    become servable. Composes with TP (``model`` axis) via the head axis.
+    Returns (state, last-valid-token logits [vocab])."""
+    S = tokens.shape[1]
+    positions = jnp.arange(S)[None, :]  # [1, S]
+    page_row = jax.lax.dynamic_slice_in_dim(state.page_table, slot, 1, axis=0)
+
+    attention = _ring_prefill_attention_fn(
+        mesh, page_row, jnp.zeros((1,), jnp.int32), n_valid[None], page_size
+    )
+    logits, (k_pages, v_pages) = forward(
+        params, tokens, positions,
+        config=config, attention=attention,
+        cache=(state.k_pages, state.v_pages),
+    )
+    last_logits = jnp.take_along_axis(
+        logits[0], jnp.maximum(n_valid - 1, 0)[None, None], axis=0
+    )[0]  # [vocab]
+
+    new_state = dataclasses.replace(
+        state,
+        k_pages=k_pages,
+        v_pages=v_pages,
+        context_lens=state.context_lens.at[slot].add(n_valid),
     )
     return new_state, last_logits
 
@@ -304,6 +383,33 @@ class InferenceEngine:
             last_tokens=self.state.last_tokens.at[idx].set(0),
         )
 
+    def _use_ring_prefill(self, prompt_len: int) -> bool:
+        return (
+            self.mesh is not None
+            and self.mesh.shape.get("seq", 1) > 1
+            and prompt_len >= self.engine_cfg.ring_prefill_min_tokens
+        )
+
+    def _ring_bucket(self, n: int) -> int:
+        """Pad a ring-prefill length to a power-of-two bucket (rounded up to
+        a seq-axis multiple) so the jit variant count is log2-bounded and
+        warmable — per-length shapes would compile fresh per request."""
+        n_seq = self.mesh.shape["seq"]
+        return -(-round_up_pow2(n) // n_seq) * n_seq
+
+    def prefill_ring(self, slot: int, prompt_ids: list[int]) -> Array:
+        """Seq-sharded one-shot prefill of a long prompt (ring attention
+        over the mesh's ``seq`` axis); returns last-token logits."""
+        assert self.mesh is not None and self.mesh.shape.get("seq", 1) > 1
+        n = len(prompt_ids)
+        S = self._ring_bucket(n)
+        tokens = jnp.asarray(prompt_ids + [0] * (S - n), jnp.int32)[None, :]
+        self.state, last_logits = ring_prefill_step(
+            self.params, self.state, tokens, jnp.int32(slot), jnp.int32(n),
+            config=self.config, page_size=self.page_size, mesh=self.mesh,
+        )
+        return last_logits
+
     def prefill_batch(self, items: list[tuple[int, list[int]]]) -> list[Array]:
         """Chunked prefill of N whole prompts together; returns each
         sequence's final-chunk last-token logits (one [vocab] array per
@@ -313,8 +419,27 @@ class InferenceEngine:
         that are exhausted ride the remaining rounds with ``n_valid = 0``
         (their KV writes go to the trash page). One weights-read serves the
         whole batch per round instead of per sequence.
+
+        Prompts past ``ring_prefill_min_tokens`` on a ``seq > 1`` mesh take
+        the seq-sharded ring path instead (one shot, O(S/seq) activation
+        memory per device).
         """
         assert items, "empty prefill batch"
+        ring = [(i, slot, ids) for i, (slot, ids) in enumerate(items)
+                if self._use_ring_prefill(len(ids))]
+        if ring:
+            results: list[Array | None] = [None] * len(items)
+            for i, slot, ids in ring:
+                results[i] = self.prefill_ring(slot, ids)
+            rest = [(i, it) for i, it in enumerate(items)
+                    if results[i] is None]
+            if rest:
+                rest_logits = self.prefill_batch([it for _, it in rest])
+                for (i, _), lg in zip(rest, rest_logits):
+                    results[i] = lg
+            assert all(r is not None for r in results)
+            return results  # type: ignore[return-value]
+
         C = self.engine_cfg.prefill_chunk
         N = len(items)
         slots = jnp.asarray([slot for slot, _ in items], jnp.int32)
@@ -375,10 +500,11 @@ class InferenceEngine:
         B = cfg.max_seqs
         if prefill_batch_sizes is None:
             # every power of two up to AND INCLUDING the scheduler's largest
-            # round padding (it pads a round of N sequences to the next
-            # power of two, which for a non-power-of-two max_seqs exceeds it)
+            # round padding (round_up_pow2 — the shared policy; for a
+            # non-power-of-two max_seqs the padding exceeds it)
+            top = round_up_pow2(B)
             prefill_batch_sizes = [1]
-            while prefill_batch_sizes[-1] < B:
+            while prefill_batch_sizes[-1] < top:
                 prefill_batch_sizes.append(prefill_batch_sizes[-1] * 2)
         C = cfg.prefill_chunk
         for n in prefill_batch_sizes:
@@ -404,6 +530,18 @@ class InferenceEngine:
             jnp.zeros((self.config.vocab_size,), jnp.float32),
             jnp.float32(0.0), jnp.float32(1.0), jnp.int32(0),
         )
+        # ring-prefill length buckets (seq > 1 meshes): every bucket the
+        # router can produce, from the threshold up to max_seq_len
+        if self.mesh is not None and self.mesh.shape.get("seq", 1) > 1:
+            S = self._ring_bucket(self.engine_cfg.ring_prefill_min_tokens)
+            while S <= self.engine_cfg.max_seq_len:
+                self.state, _ = ring_prefill_step(
+                    self.params, self.state, jnp.zeros((1, S), jnp.int32),
+                    jnp.int32(0), jnp.int32(0),
+                    config=self.config, page_size=self.page_size,
+                    mesh=self.mesh,
+                )
+                S = self._ring_bucket(S + 1)
         np.asarray(self.state.context_lens)  # barrier: compilation done
         elapsed = time.perf_counter() - t0
         logger.info(

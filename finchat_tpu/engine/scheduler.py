@@ -19,9 +19,10 @@ model replica:
   every device→host fetch runs in a worker thread so the asyncio loop
   (HTTP handlers, Kafka produces) never blocks on the chip. A sequence
   that hits EOS at step N wastes one speculative token at N+1; the host
-  discards it. Grammar-constrained sequences need their host-side pick
-  written back before the next step, so pipelining pauses while one is in
-  flight (the tool-decision phase is short).
+  discards it. A grammar-constrained sequence needs its host-side pick
+  written back before its next step, so it sits OUT the speculative step
+  (inactive, trash-redirected) and rejoins the following one — advancing
+  every other step while unconstrained streams keep full depth-2 cadence.
 - Per-sequence failure isolation (SURVEY §5.3): an errored sequence is
   evicted, its pages freed, an error event emitted on its stream, and the
   engine keeps serving the others. The process-level watchdog of the
@@ -50,7 +51,7 @@ from finchat_tpu.engine.kv_cache import PageAllocator, pages_needed
 from finchat_tpu.engine.sampler import SamplingParams
 from finchat_tpu.utils.faults import inject
 from finchat_tpu.utils.logging import get_logger
-from finchat_tpu.utils.metrics import METRICS
+from finchat_tpu.utils.metrics import METRICS, Timer
 from finchat_tpu.utils.tracing import RequestSpan
 
 logger = get_logger(__name__)
@@ -216,80 +217,93 @@ class ContinuousBatchingScheduler:
     async def _prefill_round(self) -> None:
         """Advance EVERY currently-prefilling sequence one chunk in a single
         batched ``prefill_step`` (one weights-read for the whole round). The
-        batch dim is padded to the next power of two so a burst of admissions
-        compiles at most log2(max_seqs) prefill variants, not one per N."""
+        batch dim is padded to the next power of two (round_up_pow2 — the
+        same policy Engine.warmup compiles for) so a burst of admissions
+        compiles at most log2(max_seqs) prefill variants, not one per N.
+
+        Long prompts on a ``seq > 1`` mesh take the seq-sharded ring path
+        instead (engine.prefill_ring, SURVEY §5.7c) and complete in this
+        same round."""
         eng = self.engine
         C = eng.engine_cfg.prefill_chunk
         batch: list[SequenceHandle] = []
+        # (handle, device logits row) pairs whose prompt completed this round
+        completions: list[tuple[SequenceHandle, object]] = []
         for handle in list(self.prefilling):
             try:
                 inject("scheduler.prefill", seq_id=handle.seq_id)
-            except Exception as e:  # per-sequence isolation at injection
+                if handle.prefill_pos == 0 and eng._use_ring_prefill(len(handle.prompt_ids)):
+                    with Timer(METRICS, "finchat_prefill_seconds"):
+                        ring_logits = eng.prefill_ring(handle.slot, handle.prompt_ids)
+                    handle.prefill_pos = len(handle.prompt_ids)
+                    completions.append((handle, ring_logits))
+                    continue
+            except Exception as e:  # per-sequence isolation
                 logger.error("prefill error for %s: %s", handle.seq_id, e)
                 self._evict(handle, "error", error=str(e))
                 continue
             batch.append(handle)
-        if not batch:
-            return
 
-        N = 1
-        while N < len(batch):
-            N *= 2
-        tokens = np.zeros((N, C), np.int32)
-        slots = np.zeros((N,), np.int32)
-        starts = np.zeros((N,), np.int32)
-        n_valids = np.zeros((N,), np.int32)
-        slots[:] = batch[0].slot  # padding rows: n_valid 0 → trash writes
-        for i, handle in enumerate(batch):
-            chunk = handle.prompt_ids[handle.prefill_pos : handle.prefill_pos + C]
-            tokens[i, : len(chunk)] = chunk
-            slots[i] = handle.slot
-            starts[i] = handle.prefill_pos
-            n_valids[i] = len(chunk)
-        eng.state, logits = prefill_step(
-            eng.params, eng.state,
-            jnp.asarray(tokens), jnp.asarray(slots),
-            jnp.asarray(starts), jnp.asarray(n_valids),
-            config=eng.config, page_size=eng.page_size,
-            attn_backend=eng.attn_backend,
-        )
+        if batch:
+            from finchat_tpu.engine.engine import round_up_pow2
 
-        finished: list[tuple[int, SequenceHandle]] = []
-        for i, handle in enumerate(batch):
-            handle.prefill_pos += int(n_valids[i])
-            if handle.prefill_pos >= len(handle.prompt_ids):
-                finished.append((i, handle))
-        if not finished:
+            N = round_up_pow2(len(batch))
+            tokens = np.zeros((N, C), np.int32)
+            slots = np.zeros((N,), np.int32)
+            starts = np.zeros((N,), np.int32)
+            n_valids = np.zeros((N,), np.int32)
+            slots[:] = batch[0].slot  # padding rows: n_valid 0 → trash writes
+            for i, handle in enumerate(batch):
+                chunk = handle.prompt_ids[handle.prefill_pos : handle.prefill_pos + C]
+                tokens[i, : len(chunk)] = chunk
+                slots[i] = handle.slot
+                starts[i] = handle.prefill_pos
+                n_valids[i] = len(chunk)
+            with Timer(METRICS, "finchat_prefill_seconds"):
+                # host-side dispatch time for the round (device work is
+                # async; steady-state it tracks the round cadence)
+                eng.state, logits = prefill_step(
+                    eng.params, eng.state,
+                    jnp.asarray(tokens), jnp.asarray(slots),
+                    jnp.asarray(starts), jnp.asarray(n_valids),
+                    config=eng.config, page_size=eng.page_size,
+                    attn_backend=eng.attn_backend,
+                )
+            for i, handle in enumerate(batch):
+                handle.prefill_pos += int(n_valids[i])
+                if handle.prefill_pos >= len(handle.prompt_ids):
+                    completions.append((handle, logits[i]))
+
+        if not completions:
             return  # dispatch-only round, no host sync needed
 
         tokens_dev = []
-        for row, h in finished:
+        for h, row_logits in completions:
             h.span.mark("prefill_done")
             s = h.sampling
             eng.state, token = commit_first_token(
-                eng.state, jnp.int32(h.slot), logits[row],
+                eng.state, jnp.int32(h.slot), row_logits,
                 jnp.float32(s.temperature), jnp.float32(s.top_p), jnp.int32(s.top_k),
             )
             tokens_dev.append(token)
         # one host fetch for all completions (worker thread keeps loop live)
-        fetched, logit_rows = await asyncio.to_thread(
+        fetched, logits_host = await asyncio.to_thread(
             lambda: (
                 [int(np.asarray(t)) for t in tokens_dev],
-                {
-                    row: np.asarray(logits[row])
-                    for (row, h) in finished
-                    if h.constraint is not None
-                },
+                [
+                    np.asarray(row_logits) if h.constraint is not None else None
+                    for h, row_logits in completions
+                ],
             )
         )
-        for (row, handle), token_id in zip(finished, fetched):
+        for (handle, _), token_id, row_host in zip(completions, fetched, logits_host):
             if handle.finished:  # cancelled while fetching
                 continue
             try:
                 s = handle.sampling
                 if handle.constraint is not None:
                     token_id = handle.constraint.pick(
-                        logit_rows[row], s.temperature, self._rng,
+                        row_host, s.temperature, self._rng,
                         remaining=s.max_new_tokens - handle.generated,
                         top_p=s.top_p, top_k=s.top_k,
                     )

@@ -43,6 +43,7 @@ try:  # optional backend
     HAVE_PYMONGO = True
 except ImportError:  # pragma: no cover - depends on image
     pymongo = None
+    certifi = None
     HAVE_PYMONGO = False
 
 

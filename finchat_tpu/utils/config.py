@@ -162,6 +162,10 @@ class EngineConfig:
     # compile every serving step variant at startup so the first request
     # never pays XLA compilation inside the watchdog window
     warmup_on_start: bool = True
+    # prompts at least this long prefill seq-sharded via ring attention when
+    # the mesh has a seq axis > 1 (SURVEY §5.7c); shorter ones use batched
+    # chunked prefill
+    ring_prefill_min_tokens: int = 4096
 
 
 @dataclass

@@ -1,0 +1,272 @@
+"""Production-backend contract tests against mocks (verdict r3 weak #6).
+
+The confluent-kafka and pymongo paths are deployment-only (the CI image may
+lack the services), but their CONTRACTS — librdkafka config rendering, the
+produce/poll/flush call sequences, Mongo read/write shapes and error
+mapping — are pinned here with fakes, so ``pragma: no cover`` shrinks to
+the import guards.
+"""
+
+from __future__ import annotations
+
+import json
+import types
+
+import pytest
+
+import finchat_tpu.io.kafka as kafka_mod
+import finchat_tpu.io.store as store_mod
+from finchat_tpu.io.kafka import KafkaClient
+from finchat_tpu.io.store import AI_SENDER, MongoStore
+from finchat_tpu.utils.config import GROUP_ID, KafkaConfig, StoreConfig
+
+
+# --------------------------------------------------------------------------
+# librdkafka config rendering (reference config.py:15-23)
+# --------------------------------------------------------------------------
+
+
+def test_librdkafka_config_sasl_switch():
+    plain = KafkaConfig(bootstrap_servers="broker:9092")
+    assert plain.librdkafka_config() == {
+        "bootstrap.servers": "broker:9092",
+        "security.protocol": "PLAINTEXT",
+    }
+
+    sasl = KafkaConfig(bootstrap_servers="broker:9092", username="u", password="p")
+    cfg = sasl.librdkafka_config()
+    assert cfg["security.protocol"] == "SASL_SSL"
+    assert cfg["sasl.mechanisms"] == "PLAIN"
+    assert cfg["sasl.username"] == "u"
+    assert cfg["sasl.password"] == "p"
+
+
+def test_librdkafka_config_requires_both_credentials():
+    # username without password (or vice versa) must NOT half-enable SASL
+    for kwargs in ({"username": "u"}, {"password": "p"}):
+        cfg = KafkaConfig(bootstrap_servers="b", **kwargs).librdkafka_config()
+        assert cfg["security.protocol"] == "PLAINTEXT"
+        assert "sasl.username" not in cfg
+
+
+# --------------------------------------------------------------------------
+# confluent KafkaClient path (faked confluent_kafka module)
+# --------------------------------------------------------------------------
+
+
+class _FakeKafkaMessage:
+    def __init__(self, value: bytes, error=None):
+        self._value = value
+        self._error = error
+
+    def value(self):
+        return self._value
+
+    def error(self):
+        return self._error
+
+
+class _FakeProducer:
+    def __init__(self, config):
+        self.config = config
+        self.produced: list[tuple[str, str, bytes]] = []
+        self.polls = 0
+        self.flushes = 0
+
+    def produce(self, topic, key=None, value=None):
+        self.produced.append((topic, key, value))
+
+    def poll(self, timeout):
+        self.polls += 1
+
+    def flush(self):
+        self.flushes += 1
+
+
+class _FakeConsumer:
+    def __init__(self, config):
+        self.config = config
+        self.subscribed: list[str] = []
+        self.queue: list[_FakeKafkaMessage] = []
+        self.closed = False
+
+    def subscribe(self, topics):
+        self.subscribed = list(topics)
+
+    def poll(self, timeout):
+        return self.queue.pop(0) if self.queue else None
+
+    def close(self):
+        self.closed = True
+
+
+@pytest.fixture
+def confluent_client(monkeypatch):
+    fake_module = types.SimpleNamespace(Producer=_FakeProducer, Consumer=_FakeConsumer)
+    monkeypatch.setattr(kafka_mod, "confluent_kafka", fake_module)
+    monkeypatch.setattr(kafka_mod, "HAVE_CONFLUENT", True)
+    cfg = KafkaConfig(bootstrap_servers="broker:9092", username="u", password="p",
+                      backend="confluent")
+    return KafkaClient(cfg)
+
+
+def test_confluent_producer_built_with_rendered_config(confluent_client):
+    assert confluent_client._broker is None
+    assert confluent_client._producer.config["security.protocol"] == "SASL_SSL"
+
+
+def test_confluent_consumer_setup_contract(confluent_client):
+    confluent_client.setup_consumer(["user_message"])
+    consumer = confluent_client._consumer
+    assert consumer.subscribed == ["user_message"]
+    assert consumer.config["group.id"] == GROUP_ID
+    assert consumer.config["auto.offset.reset"] == "latest"
+    assert consumer.config["session.timeout.ms"] == "45000"  # kafka_client.py:15
+
+
+def test_confluent_poll_paths(confluent_client):
+    # not initialized -> None with an error log, no crash
+    assert confluent_client.poll_message() is None
+
+    confluent_client.setup_consumer(["user_message"])
+    assert confluent_client.poll_message() is None  # empty queue
+
+    good = _FakeKafkaMessage(b'{"message": "hi"}')
+    bad = _FakeKafkaMessage(b"", error="broker down")
+    confluent_client._consumer.queue = [bad, good]
+    assert confluent_client.poll_message() is None  # errored record dropped
+    assert confluent_client.poll_message() is good
+
+
+def test_confluent_produce_qos_split(confluent_client):
+    """Normal chunks fire-and-forget (produce + poll(0)); error messages
+    flush — the reference's delivery-guarantee split (kafka_client.py:24-40)."""
+    confluent_client.produce_message("ai_response", "conv1", {"message": "tok"})
+    prod = confluent_client._producer
+    assert prod.polls == 1 and prod.flushes == 0
+    topic, key, payload = prod.produced[-1]
+    assert (topic, key) == ("ai_response", "conv1")
+    assert json.loads(payload) == {"message": "tok"}
+
+    confluent_client.produce_error_message("ai_response", "conv1", {"error": True})
+    assert prod.flushes == 1
+
+
+def test_confluent_close_contract(confluent_client):
+    confluent_client.setup_consumer()
+    confluent_client.close()
+    assert confluent_client._consumer.closed
+    assert confluent_client._producer.flushes == 1
+
+
+# --------------------------------------------------------------------------
+# MongoStore path (faked pymongo client)
+# --------------------------------------------------------------------------
+
+
+class _FakeCursor:
+    def __init__(self, rows):
+        self._rows = rows
+
+    def sort(self, field, direction):
+        return sorted(self._rows, key=lambda r: r[field], reverse=direction < 0)
+
+
+class _FakeCollection:
+    def __init__(self):
+        self.rows: list[dict] = []
+
+    def find_one(self, query):
+        for row in self.rows:
+            if all(row.get(k) == v for k, v in query.items()):
+                return row
+        return None
+
+    def find(self, query):
+        return _FakeCursor([r for r in self.rows
+                            if all(r.get(k) == v for k, v in query.items())])
+
+    def insert_one(self, doc):
+        self.rows.append(dict(doc))
+
+
+class _FakeAdmin:
+    def __init__(self, fail=False):
+        self.fail = fail
+
+    def command(self, name):
+        if self.fail:
+            raise ConnectionError("no mongod")
+        return {"ok": 1}
+
+
+class _FakeMongoClient:
+    def __init__(self, uri, tls=None, tlsCAFile=None):
+        self.uri = uri
+        self.admin = _FakeAdmin()
+        self._dbs: dict[str, dict[str, _FakeCollection]] = {}
+
+    def __getitem__(self, name):
+        db = self._dbs.setdefault(name, {})
+
+        class _DB:
+            def __getitem__(_self, coll):
+                return db.setdefault(coll, _FakeCollection())
+
+        return _DB()
+
+
+@pytest.fixture
+def mongo_store(monkeypatch):
+    fake_pymongo = types.SimpleNamespace(MongoClient=_FakeMongoClient)
+    fake_certifi = types.SimpleNamespace(where=lambda: "/fake/ca.pem")
+    monkeypatch.setattr(store_mod, "pymongo", fake_pymongo)
+    monkeypatch.setattr(store_mod, "certifi", fake_certifi, raising=False)
+    monkeypatch.setattr(store_mod, "HAVE_PYMONGO", True)
+    return MongoStore(StoreConfig(mongodb_uri="mongodb://fake", backend="mongo"))
+
+
+async def test_mongo_check_connection(mongo_store):
+    await mongo_store.check_connection()  # ok path
+    mongo_store._client.admin.fail = True
+    with pytest.raises(RuntimeError, match="MongoDB connection failed"):
+        await mongo_store.check_connection()
+
+
+async def test_mongo_get_context_contract(mongo_store):
+    with pytest.raises(LookupError):
+        await mongo_store.get_context("conv1")
+    mongo_store._contexts.insert_one({
+        "conversation_id": "conv1", "user_id": "u1", "name": "Ada",
+        "income": 90000, "savings_goal": 10000,
+    })
+    context, user_id = await mongo_store.get_context("conv1")
+    assert user_id == "u1"
+    assert "Ada" in context
+
+    # context without user_id is a hard error (reference database.py behavior)
+    mongo_store._contexts.insert_one({"conversation_id": "conv2", "name": "X"})
+    with pytest.raises(LookupError, match="user_id"):
+        await mongo_store.get_context("conv2")
+
+
+async def test_mongo_history_sorted_and_empty_raises(mongo_store):
+    with pytest.raises(LookupError):  # database.py:78-79 raise-on-empty
+        await mongo_store.get_history("conv1")
+    for ts, text in [(30, "third"), (10, "first"), (20, "second")]:
+        mongo_store._messages.insert_one({
+            "conversation_id": "conv1", "sender": "UserMessage",
+            "user_id": "u1", "message": text, "timestamp": ts,
+        })
+    history = await mongo_store.get_history("conv1")
+    assert [m.message for m in history] == ["first", "second", "third"]
+
+
+async def test_mongo_save_ai_message(mongo_store):
+    await mongo_store.save_ai_message("conv1", "answer text", "u1")
+    rows = mongo_store._messages.rows
+    assert len(rows) == 1
+    assert rows[0]["sender"] == AI_SENDER
+    assert rows[0]["message"] == "answer text"
+    assert rows[0]["user_id"] == "u1"
+    assert isinstance(rows[0]["timestamp"], int)
