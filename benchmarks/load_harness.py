@@ -61,6 +61,9 @@ async def run_load(
     tok = ByteTokenizer()
     params = init_params(config, jax.random.key(0))
     engine = InferenceEngine(config, params, engine_cfg)
+    # production startup behavior (serve/app.py): compile every step
+    # variant BEFORE traffic, so TTFT measures serving, not XLA
+    warmup_s = engine.warmup()
     scheduler = ContinuousBatchingScheduler(engine, eos_id=tok.eos_id)
     gen = EngineGenerator(scheduler, tok)
 
@@ -110,6 +113,7 @@ async def run_load(
         "new_tokens": new_tokens,
         "total_tokens": total_tokens,
         "wall_s": round(wall, 2),
+        "warmup_s": round(warmup_s, 1),
         "model": preset,
         "platform": jax.devices()[0].platform,
     }

@@ -215,14 +215,21 @@ def ring_prefill_step(
     attention = _ring_prefill_attention_fn(
         mesh, page_row, jnp.zeros((1,), jnp.int32), n_valid[None], page_size
     )
-    logits, (k_pages, v_pages) = forward(
+    # hidden states only — a full [S, vocab] fp32 logits tensor at long-S
+    # would cost GBs in exactly the regime this path exists for; project
+    # the single last-valid row instead
+    from finchat_tpu.models.llama import lm_head
+
+    hidden, (k_pages, v_pages) = forward(
         params, tokens, positions,
         config=config, attention=attention,
         cache=(state.k_pages, state.v_pages),
+        return_hidden=True,
     )
-    last_logits = jnp.take_along_axis(
-        logits[0], jnp.maximum(n_valid - 1, 0)[None, None], axis=0
-    )[0]  # [vocab]
+    last_hidden = jax.lax.dynamic_index_in_dim(
+        hidden[0], jnp.maximum(n_valid - 1, 0), axis=0, keepdims=False
+    )  # [D]
+    last_logits = lm_head(params, last_hidden, config=config)  # [vocab]
 
     new_state = dataclasses.replace(
         state,
@@ -531,16 +538,21 @@ class InferenceEngine:
             jnp.float32(0.0), jnp.float32(1.0), jnp.int32(0),
         )
         # ring-prefill length buckets (seq > 1 meshes): every bucket the
-        # router can produce, from the threshold up to max_seq_len
+        # router can produce, INCLUDING the top one covering max_seq_len
+        # (stopping at max_seq_len itself would miss e.g. the 8192 bucket a
+        # 5000-token prompt maps to under a 6000 max)
         if self.mesh is not None and self.mesh.shape.get("seq", 1) > 1:
             S = self._ring_bucket(self.engine_cfg.ring_prefill_min_tokens)
-            while S <= self.engine_cfg.max_seq_len:
+            top = self._ring_bucket(self.engine_cfg.max_seq_len)
+            while True:
                 self.state, _ = ring_prefill_step(
                     self.params, self.state, jnp.zeros((1, S), jnp.int32),
                     jnp.int32(0), jnp.int32(0),
                     config=self.config, page_size=self.page_size,
                     mesh=self.mesh,
                 )
+                if S >= top:
+                    break
                 S = self._ring_bucket(S + 1)
         np.asarray(self.state.context_lens)  # barrier: compilation done
         elapsed = time.perf_counter() - t0

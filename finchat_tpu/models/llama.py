@@ -166,8 +166,12 @@ def forward(
     attention: AttentionFn,
     cache: Any = None,  # full-depth cache pytree (carried), or None
     remat: bool = False,  # checkpoint each scanned layer (training)
+    return_hidden: bool = False,  # post-norm hidden states, no LM head
 ) -> tuple[Array, Any]:
-    """Run the decoder; returns (logits[B,S,vocab] fp32, new_cache).
+    """Run the decoder; returns (logits[B,S,vocab] fp32, new_cache) — or
+    (hidden[B,S,D], new_cache) with ``return_hidden``, for callers that
+    project only a subset of positions (the seq-sharded long prefill keeps
+    one row; a full [S, vocab] fp32 logits tensor there would cost GBs).
 
     The cache rides the layer scan as part of the CARRY and the attention
     callback receives the whole cache plus the layer index (kernels index
@@ -198,9 +202,16 @@ def forward(
     (x, new_cache), _ = lax.scan(scan_body, (x, cache), (params["layers"], layer_ids))
 
     x = rms_norm(x, params["norm"], c.norm_eps)
-    head = params["embed"].T if c.tie_embeddings else params["lm_head"]
-    logits = jnp.einsum("bsd,dv->bsv", x, head, preferred_element_type=jnp.float32)
+    if return_hidden:
+        return x, new_cache
+    logits = lm_head(params, x, config=c)
     return logits, new_cache
+
+
+def lm_head(params: dict[str, Any], x: Array, *, config: LlamaConfig) -> Array:
+    """Project hidden states [..., D] to fp32 logits [..., vocab]."""
+    head = params["embed"].T if config.tie_embeddings else params["lm_head"]
+    return jnp.einsum("...d,dv->...v", x, head, preferred_element_type=jnp.float32)
 
 
 def make_causal_attention(backend: str) -> AttentionFn:

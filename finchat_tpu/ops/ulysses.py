@@ -1,0 +1,90 @@
+"""Ulysses-style sequence parallelism: all-to-all head scatter (SURVEY §5.7d).
+
+The alternative SP mode to ring attention (ops/ring_attention.py) for long
+sequences, after DeepSpeed-Ulysses: instead of rotating K/V blocks around
+the ring, ONE all-to-all redistributes the sharding from sequence-sharded
+``[B, S/n, H, D]`` to head-sharded ``[B, S, H/n, D]``, each device runs
+ordinary FULL-sequence attention over its head group, and a second
+all-to-all restores sequence sharding. Two collectives total (vs n-1 ring
+hops), at the cost of requiring ``heads % n == 0`` and a full-sequence
+attention footprint per device — the right trade when heads are plentiful
+and S fits once per chip; ring attention remains the mode for S beyond one
+chip's HBM.
+
+GQA note: K/V heads are scattered over the same axis, so ``n`` must divide
+``n_kv_heads`` too (else fall back to ring). Head groups stay aligned with
+GQA groups because the head axis is sharded in contiguous blocks.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from finchat_tpu.ops.refs import mha_reference
+
+
+def _ulysses_body(q, k, v, *, axis: str, causal: bool):
+    """Per-device function under shard_map.
+
+    In: q [B, S/n, H, D], k/v [B, S/n, Hkv, D] (local shards).
+    Out: [B, S/n, H, D].
+    """
+    # seq-sharded -> head-sharded: split the local head axis into n groups,
+    # all-to-all exchanges (my seq block of your head group) so every device
+    # ends with the FULL sequence of its own head group.
+    def seq_to_heads(x):
+        # [B, S/n, h, D] -> [B, S, h/n, D]
+        return lax.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
+
+    def heads_to_seq(x):
+        # [B, S, h/n, D] -> [B, S/n, h, D]
+        return lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
+
+    q_h = seq_to_heads(q)
+    k_h = seq_to_heads(k)
+    v_h = seq_to_heads(v)
+    out_h = mha_reference(q_h, k_h, v_h, causal=causal)
+    return heads_to_seq(out_h)
+
+
+@partial(jax.jit, static_argnames=("mesh", "axis", "batch_axis", "head_axis", "causal"))
+def ulysses_attention(
+    q: jax.Array,  # [B, S, H, D] sharded on S over `axis`
+    k: jax.Array,  # [B, S, Hkv, D]
+    v: jax.Array,
+    *,
+    mesh: Mesh,
+    axis: str = "seq",
+    batch_axis: str | None = None,
+    head_axis: str | None = None,
+    causal: bool = True,
+) -> jax.Array:
+    """Sequence-parallel attention via head scatter; result sharded like q.
+    ``batch_axis`` (DP) and ``head_axis`` (TP over heads) compose with the
+    seq scatter — the all-to-all then redistributes each TP shard's heads.
+
+    Requires the (per-TP-shard) head counts divisible by
+    ``n = mesh.shape[axis]`` (checked); callers fall back to ring attention
+    otherwise.
+    """
+    n = mesh.shape[axis]
+    tp = mesh.shape[head_axis] if head_axis else 1
+    H, Hkv = q.shape[2], k.shape[2]
+    if H % tp or Hkv % tp or (H // tp) % n or (Hkv // tp) % n:
+        raise ValueError(
+            f"ulysses needs per-shard heads divisible by the seq axis: "
+            f"H={H}, Hkv={Hkv}, tp={tp}, n={n} — use ring attention instead"
+        )
+    spec = P(batch_axis, axis, head_axis, None)
+    fn = jax.shard_map(
+        partial(_ulysses_body, axis=axis, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)

@@ -3,8 +3,8 @@
 Axis convention (scaling-book style):
 - ``data``   — batch/DP; gradients all-reduce here.
 - ``pipe``   — pipeline parallelism; stages exchange activations point-to-
-  point (axis exposed per SURVEY §2.3, size 1 today — no stage scheduler
-  yet, so 70B-scale configs aren't boxed out of the mesh shape).
+  point via the GPipe-style microbatch scheduler in parallel/pipeline.py
+  (layer stack sharded by stage over this axis).
 - ``model``  — tensor parallelism; attention heads + MLP hidden sharded.
 - ``seq``    — sequence/context parallelism (ring attention rides this).
 - ``expert`` — expert parallelism (MoE models; axis exposed, size 1 today).

@@ -1,0 +1,240 @@
+"""Pipeline parallelism over the ``pipe`` mesh axis (SURVEY §2.3 C4).
+
+Until round 4 the ``pipe`` axis was pure surface — exposed in the mesh but
+nothing could run at ``pipe > 1``. This module is the stage scheduler: a
+GPipe-style microbatch pipeline expressed the TPU way, as a ``shard_map``
+over the mesh with stage-to-stage activation transfer via ``ppermute`` —
+point-to-point neighbor sends that ride DCN between hosts (mesh.py puts
+``pipe`` right after ``data``).
+
+The shard_map is ALL-manual: a partial-manual mapping (``axis_names=
+{'pipe'}`` with data/model left GSPMD-auto) computes the identical forward
+but its TRANSPOSE trips an XLA check failure in this toolchain ("Invalid
+binary instruction opcode copy", hlo_instruction.cc:1585) — found while
+bringing up the backward pass, round 4. Consequence: inside the pipeline,
+non-pipe mesh coordinates run replicated (stage weights live once per
+device in the stage's row), so this v1 parallelizes over ``pipe`` alone;
+re-introducing in-stage DP/TP means either the partial-manual route once
+the compiler allows it, or manual Megatron collectives in the stage block.
+
+Layer placement falls out of the existing stacked-layer layout: every
+``layers`` leaf is ``[L, ...]``, so sharding the leading axis over ``pipe``
+(parallel/sharding.py) gives each stage a contiguous block of L/P layers
+with no resharding — the same pytree serves the plain scanned forward
+(pipe=1) and the pipeline.
+
+Schedule: the classic forward-fill/drain loop. With P stages and M
+microbatches, tick t of ``M + P - 1``:
+
+  stage 0 ingests microbatch t (while t < M); every stage runs its local
+  layer block on the activation it holds; activations hop one stage via
+  ppermute; the last stage banks its output for microbatch t-(P-1).
+
+Bubble fraction is (P-1)/(M+P-1) — callers pick ``n_micro >> P``. The loop
+is a ``lax.scan`` so the whole pipeline is reverse-differentiable (ppermute
+transposes to the reverse permutation), giving 1F1B-equivalent memory via
+the usual remat-on-stage trade (``remat=True`` checkpoints each stage
+block).
+
+Composition note: the pipeline body runs cache-less full attention (the
+training / long-prefill shape). SP (ring/Ulysses) composes with DP/TP in
+train_step.py; PPxSP in one step is future work — the axes are mesh-
+compatible but the pipeline feeds full-sequence blocks today.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from finchat_tpu.models.llama import (
+    LlamaConfig,
+    _layer,
+    lm_head,
+    make_causal_attention,
+    rms_norm,
+)
+from finchat_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def _stage_block(x, layers_local, positions, *, config, attention, remat):
+    """Run this stage's local layer block (scan over L/P layers)."""
+
+    def body(x, scanned):
+        layer_params, = scanned
+        x, _ = _layer(
+            x, layer_params, None, jnp.int32(0),
+            positions=positions, config=config, attention=attention,
+        )
+        return x, None
+
+    if remat:
+        body = jax.checkpoint(body)
+    x, _ = lax.scan(body, x, (layers_local,))
+    return x
+
+
+def _pipeline_body(
+    layers_local: dict[str, Any],
+    x: jax.Array,  # [B, S, D] embedded input (replicated over pipe)
+    positions: jax.Array,  # [B, S]
+    *,
+    config: LlamaConfig,
+    n_micro: int,
+    n_stages: int,
+    attention,
+    remat: bool,
+):
+    """Per-device pipeline schedule under shard_map (manual axis: pipe)."""
+    B, S, D = x.shape
+    mb = B // n_micro
+    stage = lax.axis_index("pipe")
+    is_first = stage == 0
+    is_last = stage == n_stages - 1
+    perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+    held0 = lax.pcast(jnp.zeros((mb, S, D), x.dtype), ("pipe",), to="varying")
+    out0 = lax.pcast(jnp.zeros((B, S, D), x.dtype), ("pipe",), to="varying")
+
+    def tick(carry, t):
+        held, outputs = carry
+        # stage 0 ingests microbatch t (clamped; junk past M never reaches
+        # the last stage before the loop ends)
+        start = jnp.minimum(t, n_micro - 1) * mb
+        ingest = lax.dynamic_slice_in_dim(x, start, mb, axis=0)
+        act = jnp.where(is_first, ingest, held)
+        # NOTE: every stage must use the positions of the microbatch it is
+        # currently processing — stage s at tick t holds microbatch t-s.
+        # With per-row position offsets this matters; slice with the same
+        # clamp as the ingest and shift by the stage index.
+        pos_start = jnp.clip(t - stage, 0, n_micro - 1) * mb
+        pos_mb = lax.dynamic_slice_in_dim(positions, pos_start, mb, axis=0)
+        act = _stage_block(
+            act, layers_local, pos_mb,
+            config=config, attention=attention, remat=remat,
+        )
+        # bank the last stage's finished microbatch t-(P-1)
+        out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1) * mb
+        prev = lax.dynamic_slice_in_dim(outputs, out_idx, mb, axis=0)
+        bank = jnp.where(jnp.logical_and(is_last, t >= n_stages - 1), act, prev)
+        outputs = lax.dynamic_update_slice_in_dim(outputs, bank, out_idx, axis=0)
+        # hop to the next stage (the last stage's act is not forwarded)
+        held = lax.ppermute(act, "pipe", perm)
+        return (held, outputs), None
+
+    (_, outputs), _ = lax.scan(
+        tick, (held0, out0), jnp.arange(n_micro + n_stages - 1)
+    )
+    # stack per-stage outputs on a leading pipe axis; caller takes the last
+    return outputs[None]
+
+
+def pipeline_forward(
+    params: dict[str, Any],
+    tokens: jax.Array,  # [B, S] int32
+    positions: jax.Array,  # [B, S] int32
+    *,
+    config: LlamaConfig,
+    mesh: Mesh,
+    n_micro: int,
+    attn_backend: str = "ref",
+    remat: bool = True,
+) -> jax.Array:
+    """Full forward through the stage pipeline; returns logits [B,S,vocab].
+
+    Requires ``n_layers % pipe == 0`` and ``B % n_micro == 0``. Embedding,
+    final norm, and the LM head run replicated outside the pipeline (they
+    are small next to the layer stack)."""
+    n_stages = mesh.shape["pipe"]
+    assert config.n_layers % n_stages == 0, (config.n_layers, n_stages)
+    assert tokens.shape[0] % n_micro == 0, (tokens.shape, n_micro)
+
+    x = params["embed"][tokens]
+    attention = make_causal_attention(attn_backend)
+
+    layer_specs = jax.tree_util.tree_map(
+        lambda _: P("pipe"), params["layers"]
+    )
+    fn = jax.shard_map(
+        partial(
+            _pipeline_body,
+            config=config, n_micro=n_micro, n_stages=n_stages,
+            attention=attention, remat=remat,
+        ),
+        mesh=mesh,
+        in_specs=(layer_specs, P(), P()),
+        out_specs=P("pipe"),
+    )
+    stacked = fn(params["layers"], x, positions)  # [pipe, B, S, D]
+    x = stacked[-1]
+
+    x = rms_norm(x, params["norm"], config.norm_eps)
+    return lm_head(params, x, config=config)
+
+
+def make_pipeline_train_step(
+    config: LlamaConfig,
+    optimizer,
+    mesh: Mesh,
+    *,
+    n_micro: int,
+    attn_backend: str = "ref",
+    remat: bool = True,
+):
+    """Jitted train step running the forward through the stage pipeline.
+
+    The backward pass re-traverses the schedule in reverse (scan transpose;
+    ppermute transposes to the reverse hop), so gradients for each stage's
+    layers accumulate on that stage — no parameter resharding. Params must
+    be placed with ``shard_params_for_pipeline``.
+    """
+    import optax
+
+    from finchat_tpu.train.train_step import TrainState
+
+    def loss_fn(params, tokens):
+        B, S = tokens.shape
+        positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+        logits = pipeline_forward(
+            params, tokens, positions,
+            config=config, mesh=mesh, n_micro=n_micro,
+            attn_backend=attn_backend, remat=remat,
+        )
+        targets = tokens[:, 1:]
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits[:, :-1, :], targets)
+        return ce.mean()
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def train_step(state: "TrainState", tokens: jax.Array):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params=params, opt_state=opt_state, step=state.step + 1), loss
+
+    return train_step
+
+
+def shard_params_for_pipeline(params: dict[str, Any], mesh: Mesh) -> dict[str, Any]:
+    """Place params with the stacked layer axis sharded over ``pipe``
+    (matching the pipeline's all-manual in_specs exactly, so entry incurs
+    no resharding); embed/norm/head replicated."""
+    from finchat_tpu.parallel.sharding import shard_params
+
+    def ns(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    shardings: dict[str, Any] = {
+        "embed": ns(),
+        "layers": jax.tree_util.tree_map(lambda _: ns("pipe"), params["layers"]),
+        "norm": ns(),
+    }
+    if "lm_head" in params:
+        shardings["lm_head"] = ns()
+    return shard_params(params, shardings)

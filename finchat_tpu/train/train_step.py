@@ -48,6 +48,23 @@ def _ring_attention_callback(mesh: Mesh) -> Callable:
     return attention
 
 
+def _ulysses_attention_callback(mesh: Mesh) -> Callable:
+    """SP via Ulysses head scatter (ops/ulysses.py) — two all-to-alls per
+    layer instead of a ring; needs heads divisible by the seq axis.
+    Composition note: heads here are the LOCAL (TP-sharded) head count, so
+    the divisibility requirement applies after the model axis split."""
+    from finchat_tpu.ops.ulysses import ulysses_attention
+
+    def attention(q, k, v, layer_cache, layer_idx):
+        out = ulysses_attention(
+            q, k, v, mesh=mesh, axis="seq", batch_axis="data",
+            head_axis="model", causal=True,
+        )
+        return out, layer_cache
+
+    return attention
+
+
 def make_optimizer(learning_rate: float = 1e-4, weight_decay: float = 0.01) -> optax.GradientTransformation:
     return optax.adamw(learning_rate, b1=0.9, b2=0.95, weight_decay=weight_decay)
 
@@ -62,16 +79,25 @@ def make_train_step(
     mesh: Mesh | None = None,
     *,
     use_ring_attention: bool = False,
+    sp_mode: str = "ring",  # "ring" | "ulysses" (when use_ring_attention)
     remat: bool = True,
 ):
     """Build the jitted train step.
 
     ``batch``: token ids [B, S] (B sharded on ``data``, S on ``seq`` when
-    ring attention is on). Loss is next-token CE over positions 0..S-2.
+    SP is on). Loss is next-token CE over positions 0..S-2. ``sp_mode``
+    picks the sequence-parallel attention: ``ring`` (K/V rotate the ICI
+    ring; any head count, S beyond one chip) or ``ulysses`` (two
+    all-to-alls; needs per-TP-shard heads divisible by the seq axis).
     """
     if use_ring_attention:
-        assert mesh is not None, "ring attention needs a mesh"
-        attention = _ring_attention_callback(mesh)
+        assert mesh is not None, "sequence parallelism needs a mesh"
+        if sp_mode not in ("ring", "ulysses"):
+            raise ValueError(f"unknown sp_mode {sp_mode!r} (want 'ring' or 'ulysses')")
+        if sp_mode == "ulysses":
+            attention = _ulysses_attention_callback(mesh)
+        else:
+            attention = _ring_attention_callback(mesh)
     else:
         # resolve the backend NOW (build time), not at trace time — the jit
         # cache below is not keyed on env state (see ops/dispatch.py)

@@ -245,3 +245,129 @@ def test_scheduler_routes_long_prompts_through_ring_prefill():
     got = asyncio.run(run())
     assert got == 4
     assert ring_calls == [61], ring_calls
+
+
+def test_ulysses_attention_matches_reference():
+    """SURVEY §5.7d: Ulysses all-to-all SP == dense reference, MHA and GQA."""
+    from finchat_tpu.ops.ulysses import ulysses_attention
+
+    mesh = build_mesh(MeshSpec(data=2, seq=4, expert=1, model=1))
+    B, S, D = 2, 64, 16
+    for H, Hkv in ((8, 8), (8, 4)):
+        kq, kk, kv = jax.random.split(jax.random.key(H), 3)
+        q = jax.random.normal(kq, (B, S, H, D), jnp.float32)
+        k = jax.random.normal(kk, (B, S, Hkv, D), jnp.float32)
+        v = jax.random.normal(kv, (B, S, Hkv, D), jnp.float32)
+        for causal in (True, False):
+            out = ulysses_attention(q, k, v, mesh=mesh, causal=causal)
+            ref = mha_reference(q, k, v, causal=causal)
+            assert float(jnp.abs(out - ref).max()) < 1e-4, (H, Hkv, causal)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    from finchat_tpu.ops.ulysses import ulysses_attention
+
+    mesh = build_mesh(MeshSpec(data=1, seq=8, expert=1, model=1))
+    q = jnp.zeros((1, 16, 4, 8))  # 4 heads, seq axis 8 -> indivisible
+    with pytest.raises(ValueError, match="ring attention instead"):
+        ulysses_attention(q, q, q, mesh=mesh)
+
+
+def test_train_step_ulysses_sp():
+    """The Ulysses SP mode trains: DP x SP(ulysses) x TP on the CPU mesh."""
+    from finchat_tpu.parallel.sharding import llama_param_shardings, shard_params
+    from finchat_tpu.train.train_step import (
+        init_train_state, make_optimizer, make_train_step, shard_batch,
+    )
+
+    mesh = build_mesh(MeshSpec(data=2, seq=2, expert=1, model=2))
+    config = LlamaConfig(
+        vocab_size=64, dim=64, n_layers=2, n_heads=8, n_kv_heads=4,
+        hidden_dim=64, max_seq_len=32,
+    )  # per-TP-shard heads 4/2, divisible by seq=2
+    params = shard_params(init_params(config, jax.random.key(0)), llama_param_shardings(mesh))
+    optimizer = make_optimizer(learning_rate=1e-2)
+    step = make_train_step(config, optimizer, mesh, use_ring_attention=True, sp_mode="ulysses")
+    state = init_train_state(config, params, optimizer)
+    tokens = shard_batch(
+        jax.random.randint(jax.random.key(1), (4, 16), 0, 64), mesh, seq_sharded=True
+    )
+    losses = []
+    for _ in range(5):
+        state, loss = step(state, tokens)
+        losses.append(float(loss))
+    assert all(jnp.isfinite(jnp.asarray(losses)))
+    assert losses[-1] < losses[0], losses
+
+
+def test_pipeline_forward_matches_plain():
+    """C4 (SURVEY §2.3): the GPipe-style stage pipeline at pipe=2 computes
+    the SAME function as the plain scanned forward, for every microbatch
+    count (fill/drain schedule correctness). Non-pipe mesh axes run
+    replicated inside the pipeline (see parallel/pipeline.py docstring)."""
+    import numpy as np
+
+    from finchat_tpu.models.llama import forward, make_causal_attention
+    from finchat_tpu.parallel.pipeline import pipeline_forward, shard_params_for_pipeline
+
+    config = LlamaConfig(
+        vocab_size=64, dim=32, n_layers=4, n_heads=4, n_kv_heads=2,
+        hidden_dim=64, max_seq_len=32, dtype=jnp.float32,
+    )
+    mesh = build_mesh(MeshSpec(data=2, pipe=2, seq=1, expert=1, model=2))
+    params = init_params(config, jax.random.key(0))
+    B, S = 4, 16
+    tokens = jax.random.randint(jax.random.key(1), (B, S), 0, 64)
+    positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+
+    ref, _ = forward(params, tokens, positions, config=config,
+                     attention=make_causal_attention("ref"))
+    sharded = shard_params_for_pipeline(params, mesh)
+    for n_micro in (1, 2, 4):
+        got = pipeline_forward(
+            sharded, tokens, positions, config=config, mesh=mesh, n_micro=n_micro
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), atol=1e-4, rtol=1e-4,
+            err_msg=f"n_micro={n_micro}",
+        )
+
+    # PER-ROW position offsets: each stage must use the positions of the
+    # microbatch it currently holds, not microbatch 0's
+    offsets = jnp.asarray([0, 3, 8, 1])[:, None]
+    pos2 = offsets + jnp.arange(S)[None, :]
+    ref2, _ = forward(params, tokens, pos2, config=config,
+                      attention=make_causal_attention("ref"))
+    got2 = pipeline_forward(
+        sharded, tokens, pos2, config=config, mesh=mesh, n_micro=4
+    )
+    np.testing.assert_allclose(
+        np.asarray(got2), np.asarray(ref2), atol=1e-4, rtol=1e-4,
+        err_msg="per-row positions",
+    )
+
+
+def test_pipeline_train_step_learns():
+    """The pipelined train step backprops through the fill/drain schedule
+    (scan + ppermute transpose): loss decreases memorizing one tiny batch."""
+    from finchat_tpu.parallel.pipeline import (
+        make_pipeline_train_step, shard_params_for_pipeline,
+    )
+    from finchat_tpu.train.train_step import init_train_state, make_optimizer
+
+    config = LlamaConfig(
+        vocab_size=64, dim=32, n_layers=4, n_heads=4, n_kv_heads=2,
+        hidden_dim=64, max_seq_len=32,
+    )
+    mesh = build_mesh(MeshSpec(data=2, pipe=2, seq=1, expert=1, model=2))
+    params = shard_params_for_pipeline(init_params(config, jax.random.key(0)), mesh)
+    optimizer = make_optimizer(learning_rate=1e-2)
+    step = make_pipeline_train_step(config, optimizer, mesh, n_micro=2)
+    state = init_train_state(config, params, optimizer)
+    tokens = jax.random.randint(jax.random.key(1), (4, 16), 0, 64)
+    losses = []
+    for _ in range(5):
+        state, loss = step(state, tokens)
+        losses.append(float(loss))
+    assert all(jnp.isfinite(jnp.asarray(losses))), losses
+    assert losses[-1] < losses[0], losses
