@@ -67,12 +67,19 @@ def load_llama_params(
             "num_key_value_heads": config.n_kv_heads,
             "intermediate_size": config.hidden_dim,
             "vocab_size": config.vocab_size,
+            "num_local_experts": config.n_experts,  # Mixtral-family
+            "num_experts_per_tok": config.top_k_experts,
         }
         for hf_key, ours in mismatches.items():
             if hf_key in hf_cfg and hf_cfg[hf_key] != ours:
                 raise ValueError(
                     f"checkpoint {hf_key}={hf_cfg[hf_key]} != config {ours}; wrong preset?"
                 )
+        if config.n_experts and "num_local_experts" not in hf_cfg:
+            raise ValueError(
+                "config expects an MoE checkpoint (n_experts="
+                f"{config.n_experts}) but config.json has no num_local_experts"
+            )
 
     dtype = config.dtype
 
@@ -96,14 +103,41 @@ def load_llama_params(
             "attn_k": put("layers/attn_k", stack("model.layers.{i}.self_attn.k_proj.weight")),
             "attn_v": put("layers/attn_v", stack("model.layers.{i}.self_attn.v_proj.weight")),
             "attn_o": put("layers/attn_o", stack("model.layers.{i}.self_attn.o_proj.weight")),
-            "mlp_gate": put("layers/mlp_gate", stack("model.layers.{i}.mlp.gate_proj.weight")),
-            "mlp_up": put("layers/mlp_up", stack("model.layers.{i}.mlp.up_proj.weight")),
-            "mlp_down": put("layers/mlp_down", stack("model.layers.{i}.mlp.down_proj.weight")),
             "ln_attn": put("layers/ln_attn", stack("model.layers.{i}.input_layernorm.weight", transpose=False)),
             "ln_mlp": put("layers/ln_mlp", stack("model.layers.{i}.post_attention_layernorm.weight", transpose=False)),
         },
         "norm": put("norm", tensors["model.norm.weight"]),
     }
+    if config.n_experts:
+        # Mixtral layout: block_sparse_moe.gate (router) + experts.{e}.w1/w3/w2
+        # (gate/up/down) — stacked to [L, E, in, out]
+        def stack_experts(w: str) -> np.ndarray:
+            return np.stack([
+                np.stack([
+                    tensors[f"model.layers.{i}.block_sparse_moe.experts.{e}.{w}.weight"].T
+                    for e in range(config.n_experts)
+                ])
+                for i in range(config.n_layers)
+            ])
+
+        # router stays fp32: routing decisions are precision-sensitive and
+        # the tensor is tiny ([L, D, E])
+        router = np.stack([
+            tensors[f"model.layers.{i}.block_sparse_moe.gate.weight"].T
+            for i in range(config.n_layers)
+        ])
+        params["layers"].update({
+            "router": jnp.asarray(router, jnp.float32),
+            "moe_gate": put("layers/moe_gate", stack_experts("w1")),
+            "moe_up": put("layers/moe_up", stack_experts("w3")),
+            "moe_down": put("layers/moe_down", stack_experts("w2")),
+        })
+    else:
+        params["layers"].update({
+            "mlp_gate": put("layers/mlp_gate", stack("model.layers.{i}.mlp.gate_proj.weight")),
+            "mlp_up": put("layers/mlp_up", stack("model.layers.{i}.mlp.up_proj.weight")),
+            "mlp_down": put("layers/mlp_down", stack("model.layers.{i}.mlp.down_proj.weight")),
+        })
     if "lm_head.weight" in tensors:
         params["lm_head"] = put("lm_head", tensors["lm_head.weight"].T)
     else:

@@ -44,6 +44,11 @@ class LlamaConfig:
     max_seq_len: int = 2048
     dtype: Any = jnp.bfloat16
     tie_embeddings: bool = False
+    # MoE (Mixtral-family): 0 = dense MLP. When > 0 the per-layer MLP is
+    # n_experts SwiGLU experts with top-k routing; expert weights shard
+    # over the mesh's `expert` axis (EP) — see moe_mlp below.
+    n_experts: int = 0
+    top_k_experts: int = 2
 
     @property
     def head_dim(self) -> int:
@@ -67,6 +72,16 @@ PRESETS: dict[str, LlamaConfig] = {
         vocab_size=128_256, dim=8192, n_layers=80, n_heads=64, n_kv_heads=8,
         hidden_dim=28_672, rope_theta=500_000.0, max_seq_len=8192,
     ),
+    # random-weight MoE debug config (Mixtral-shaped routing, tiny dims)
+    "moe-tiny": LlamaConfig(
+        vocab_size=260, dim=128, n_layers=2, n_heads=4, n_kv_heads=2,
+        hidden_dim=256, n_experts=4, top_k_experts=2,
+    ),
+    "mixtral-8x7b": LlamaConfig(
+        vocab_size=32_000, dim=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+        hidden_dim=14_336, rope_theta=1_000_000.0, max_seq_len=8192,
+        n_experts=8, top_k_experts=2,
+    ),
 }
 
 
@@ -85,7 +100,7 @@ def init_params(config: LlamaConfig, key: Array) -> dict[str, Any]:
     def dense(k: Array, shape: tuple[int, ...], fan_in: int) -> Array:
         return (jax.random.normal(k, shape, jnp.float32) * fan_in ** -0.5).astype(c.dtype)
 
-    keys = jax.random.split(k_layers, 7)
+    keys = jax.random.split(k_layers, 8)
     L, D, H, Hkv, hd, F = c.n_layers, c.dim, c.n_heads, c.n_kv_heads, c.head_dim, c.hidden_dim
     params: dict[str, Any] = {
         "embed": dense(k_embed, (c.vocab_size, D), D),
@@ -94,14 +109,30 @@ def init_params(config: LlamaConfig, key: Array) -> dict[str, Any]:
             "attn_k": dense(keys[1], (L, D, Hkv * hd), D),
             "attn_v": dense(keys[2], (L, D, Hkv * hd), D),
             "attn_o": dense(keys[3], (L, H * hd, D), H * hd),
-            "mlp_gate": dense(keys[4], (L, D, F), D),
-            "mlp_up": dense(keys[5], (L, D, F), D),
-            "mlp_down": dense(keys[6], (L, F, D), F),
             "ln_attn": jnp.ones((L, D), c.dtype),
             "ln_mlp": jnp.ones((L, D), c.dtype),
         },
         "norm": jnp.ones((D,), c.dtype),
     }
+    if c.n_experts:
+        E = c.n_experts
+        params["layers"].update(
+            {
+                # router stays fp32: routing is precision-sensitive, tiny
+                "router": jax.random.normal(keys[7], (L, D, E), jnp.float32) * D ** -0.5,
+                "moe_gate": dense(keys[4], (L, E, D, F), D),
+                "moe_up": dense(keys[5], (L, E, D, F), D),
+                "moe_down": dense(keys[6], (L, E, F, D), F),
+            }
+        )
+    else:
+        params["layers"].update(
+            {
+                "mlp_gate": dense(keys[4], (L, D, F), D),
+                "mlp_up": dense(keys[5], (L, D, F), D),
+                "mlp_down": dense(keys[6], (L, F, D), F),
+            }
+        )
     if not c.tie_embeddings:
         params["lm_head"] = dense(k_head, (D, c.vocab_size), D)
     return params
@@ -125,6 +156,38 @@ def rope(x: Array, positions: Array, theta: float) -> Array:
     x1, x2 = x32[..., :half], x32[..., half:]
     rotated = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
     return rotated.astype(x.dtype)
+
+
+def moe_mlp(h: Array, layer_params: dict[str, Array], config: LlamaConfig) -> Array:
+    """Mixtral-style top-k routed SwiGLU experts, expert-parallel the GSPMD
+    way: expert weights carry a leading E axis sharded over the mesh's
+    ``expert`` axis (parallel/sharding.py), every expert computes over all
+    tokens with its gate weight zeroed where not routed, and XLA turns the
+    expert-sum into a psum over the EP shards. Dense dispatch — no token
+    dropping / capacity factor; per-token FLOPs scale with E rather than
+    top_k, the classic trade for static shapes at small E. A
+    capacity-bucketed all_to_all dispatch is the upgrade path when E is
+    large enough for dense dispatch to dominate the profile.
+    """
+    c = config
+    E = c.n_experts
+    # router in fp32 (routing decisions are precision-sensitive; the router
+    # leaf itself is kept fp32 by init_params / the checkpoint loader)
+    r = jnp.einsum("bsd,de->bse", h, layer_params["router"],
+                   preferred_element_type=jnp.float32)  # [B,S,E]
+    # exactly-k selection from top_k INDICES (threshold comparison would
+    # over-select on tied logits); softmax over the selected logits only
+    # (Mixtral renormalization), scattered back to expert positions
+    top_vals, top_idx = jax.lax.top_k(r, c.top_k_experts)  # [B,S,k]
+    w = jax.nn.softmax(top_vals, axis=-1)  # [B,S,k]
+    onehot = jax.nn.one_hot(top_idx, E, dtype=w.dtype)  # [B,S,k,E]
+    gates = jnp.einsum("bske,bsk->bse", onehot, w).astype(h.dtype)  # [B,S,E]
+
+    gate = jnp.einsum("bsd,edf->bsef", h, layer_params["moe_gate"])
+    up = jnp.einsum("bsd,edf->bsef", h, layer_params["moe_up"])
+    act = jax.nn.silu(gate.astype(jnp.float32)).astype(up.dtype) * up
+    act = act * gates[..., None]  # zero non-routed experts pre-projection
+    return jnp.einsum("bsef,efd->bsd", act, layer_params["moe_down"])
 
 
 def _layer(
@@ -151,9 +214,12 @@ def _layer(
     x = x + (attn_out.reshape(B, S, -1) @ layer_params["attn_o"])
 
     h = rms_norm(x, layer_params["ln_mlp"], c.norm_eps)
-    gate = h @ layer_params["mlp_gate"]
-    up = h @ layer_params["mlp_up"]
-    x = x + ((jax.nn.silu(gate.astype(jnp.float32)).astype(up.dtype) * up) @ layer_params["mlp_down"])
+    if c.n_experts:
+        x = x + moe_mlp(h, layer_params, c)
+    else:
+        gate = h @ layer_params["mlp_gate"]
+        up = h @ layer_params["mlp_up"]
+        x = x + ((jax.nn.silu(gate.astype(jnp.float32)).astype(up.dtype) * up) @ layer_params["mlp_down"])
     return x, new_layer_cache
 
 

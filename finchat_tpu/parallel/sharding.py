@@ -51,6 +51,13 @@ def llama_param_shardings(mesh: Mesh) -> dict[str, Any]:
             "mlp_gate": ns(None, None, "model"),
             "mlp_up": ns(None, None, "model"),
             "mlp_down": ns(None, "model", None),
+            # MoE (models with n_experts > 0): EP over `expert` on the
+            # leading expert dim, TP over `model` on the hidden dim — the
+            # expert-sum becomes a psum over EP shards (GSPMD inserts it)
+            "router": ns(None, None, None),  # fp32 routing, replicated
+            "moe_gate": ns(None, "expert", None, "model"),  # [L, E, D, F]
+            "moe_up": ns(None, "expert", None, "model"),
+            "moe_down": ns(None, "expert", "model", None),  # [L, E, F, D]
             "ln_attn": ns(None, None),
             "ln_mlp": ns(None, None),
         },
@@ -121,9 +128,16 @@ def _fit_sharding(
 
 def shard_params(params: dict[str, Any], shardings: dict[str, Any]) -> dict[str, Any]:
     """Place a (host or single-device) param tree onto the mesh. Sharding
-    entries with no matching param (e.g. ``lm_head`` under tied embeddings)
-    are ignored; non-dividing dims are replicated."""
-    pruned = {k: v for k, v in shardings.items() if k in params}
+    entries with no matching param (e.g. ``lm_head`` under tied embeddings,
+    MoE specs on a dense model) are pruned at every dict level; non-dividing
+    dims are replicated."""
+
+    def prune(spec, tree):
+        if isinstance(spec, dict) and isinstance(tree, dict):
+            return {k: prune(spec[k], v) for k, v in tree.items()}
+        return spec
+
+    pruned = prune(shardings, params)
     return jax.tree.map(
         lambda x, s: jax.device_put(x, _fit_sharding(s, x.shape, x.nbytes)),
         params, pruned,

@@ -1,0 +1,156 @@
+"""MoE / expert parallelism (SURVEY §2.3 C6).
+
+The MoE block is Mixtral-shaped (top-k routed SwiGLU experts) with expert
+weights sharded over the mesh's ``expert`` axis — GSPMD turns the
+expert-sum into a psum over EP shards. These tests pin routing semantics,
+EP-sharded == unsharded parity, engine serving with an MoE config, and a
+learning EPxDPxTP train step.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from finchat_tpu.models.llama import (
+    PRESETS,
+    LlamaConfig,
+    forward,
+    init_params,
+    make_causal_attention,
+    moe_mlp,
+)
+from finchat_tpu.parallel.mesh import MeshSpec, build_mesh
+from finchat_tpu.parallel.sharding import llama_param_shardings, shard_params
+
+
+def _moe_cfg(**kw):
+    base = dict(
+        vocab_size=64, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        hidden_dim=64, max_seq_len=32, n_experts=4, top_k_experts=2,
+        dtype=jnp.float32,
+    )
+    base.update(kw)
+    return LlamaConfig(**base)
+
+
+def test_moe_mlp_matches_per_token_reference():
+    """moe_mlp == a per-token numpy reference that routes each token to its
+    top-k experts, renormalizes the selected logits, and sums the selected
+    experts' SwiGLU outputs (Mixtral semantics). Catches regressions in the
+    actual implementation, not a re-derivation of it."""
+    config = _moe_cfg()
+    params = init_params(config, jax.random.key(0))
+    lp = jax.tree_util.tree_map(lambda a: a[0], params["layers"])
+    B, S = 2, 8
+    h = jax.random.normal(jax.random.key(1), (B, S, config.dim), jnp.float32)
+
+    out = np.asarray(moe_mlp(h, lp, config))
+
+    hn = np.asarray(h, np.float64)
+    router = np.asarray(lp["router"], np.float64)
+    Wg = np.asarray(lp["moe_gate"], np.float64)
+    Wu = np.asarray(lp["moe_up"], np.float64)
+    Wd = np.asarray(lp["moe_down"], np.float64)
+    ref = np.zeros_like(hn)
+    k = config.top_k_experts
+    for b in range(B):
+        for s in range(S):
+            x = hn[b, s]
+            logits = x @ router
+            top = np.argsort(-logits)[:k]  # exactly k experts
+            sel = np.exp(logits[top] - logits[top].max())
+            weights = sel / sel.sum()
+            for e, w in zip(top, weights):
+                gate = x @ Wg[e]
+                up = x @ Wu[e]
+                act = gate / (1 + np.exp(-gate)) * up  # silu * up
+                ref[b, s] += w * (act @ Wd[e])
+    np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
+
+
+def test_moe_selects_exactly_k_even_on_ties():
+    """Tied router logits must not over-select: gates come from top_k
+    INDICES, so exactly top_k experts carry weight."""
+    config = _moe_cfg()
+    params = init_params(config, jax.random.key(0))
+    lp = dict(jax.tree_util.tree_map(lambda a: a[0], params["layers"]))
+    # zero router -> ALL logits tie at 0 for every token
+    lp["router"] = jnp.zeros_like(lp["router"])
+    h = jax.random.normal(jax.random.key(2), (1, 4, config.dim), jnp.float32)
+    out = moe_mlp(h, lp, config)
+    assert bool(jnp.isfinite(out).all())
+    # reconstruct gates the way moe_mlp does to assert the exact-k property
+    r = jnp.zeros((1, 4, config.n_experts), jnp.float32)
+    top_vals, top_idx = jax.lax.top_k(r, config.top_k_experts)
+    w = jax.nn.softmax(top_vals, axis=-1)
+    gates = jnp.einsum("bske,bsk->bse", jax.nn.one_hot(top_idx, config.n_experts), w)
+    np.testing.assert_array_equal(np.asarray((gates > 0).sum(-1)), config.top_k_experts)
+    np.testing.assert_allclose(np.asarray(gates.sum(-1)), 1.0, atol=1e-6)
+
+
+def test_moe_forward_and_engine_serving():
+    """moe-tiny preset serves through the full engine path (prefill +
+    paged decode), producing valid greedy tokens."""
+    from finchat_tpu.engine.engine import InferenceEngine, commit_first_token
+    from finchat_tpu.engine.kv_cache import PageAllocator, pages_needed
+    from finchat_tpu.utils.config import EngineConfig
+
+    config = PRESETS["moe-tiny"]
+    engine_cfg = EngineConfig(
+        max_seqs=2, page_size=8, num_pages=32, max_seq_len=64, prefill_chunk=8
+    )
+    params = init_params(config, jax.random.key(0))
+    eng = InferenceEngine(config, params, engine_cfg, attn_backend="ref")
+    alloc = PageAllocator(engine_cfg.num_pages)
+    prompt = [3, 7, 11, 200, 42, 9]
+    pages = alloc.allocate("s", pages_needed(len(prompt) + 4, 8))
+    eng.set_page_table_row(0, pages)
+    logits = eng.prefill(0, prompt)
+    eng.state, tok = commit_first_token(
+        eng.state, jnp.int32(0), logits, jnp.float32(0.0), jnp.float32(1.0), jnp.int32(0)
+    )
+    out = [int(tok)]
+    active = jnp.zeros((2,), bool).at[0].set(True)
+    z, o, zk = jnp.zeros((2,)), jnp.ones((2,)), jnp.zeros((2,), jnp.int32)
+    for _ in range(3):
+        out.append(int(eng.decode(active, z, o, zk)[0]))
+    assert all(0 <= t < config.vocab_size for t in out), out
+
+
+def test_moe_ep_sharded_matches_unsharded():
+    """Expert-parallel placement (expert=2 x model=2 mesh) computes the
+    same logits as unsharded (fp32)."""
+    config = _moe_cfg()
+    params = init_params(config, jax.random.key(0))
+    B, S = 2, 16
+    tokens = jax.random.randint(jax.random.key(1), (B, S), 0, 64)
+    positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+
+    ref, _ = forward(params, tokens, positions, config=config,
+                     attention=make_causal_attention("ref"))
+
+    mesh = build_mesh(MeshSpec(data=2, seq=1, expert=2, model=2))
+    sharded = shard_params(params, llama_param_shardings(mesh))
+    got, _ = forward(sharded, tokens, positions, config=config,
+                     attention=make_causal_attention("ref"))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-4, rtol=1e-4)
+
+
+def test_moe_train_step_learns_ep_dp_tp():
+    from finchat_tpu.train.train_step import (
+        init_train_state, make_optimizer, make_train_step,
+    )
+
+    config = _moe_cfg(dtype=jnp.bfloat16)
+    mesh = build_mesh(MeshSpec(data=2, seq=1, expert=2, model=2))
+    params = shard_params(init_params(config, jax.random.key(0)), llama_param_shardings(mesh))
+    optimizer = make_optimizer(learning_rate=1e-2)
+    step = make_train_step(config, optimizer, mesh)
+    state = init_train_state(config, params, optimizer)
+    tokens = jax.random.randint(jax.random.key(1), (4, 16), 0, 64)
+    losses = []
+    for _ in range(5):
+        state, loss = step(state, tokens)
+        losses.append(float(loss))
+    assert all(jnp.isfinite(jnp.asarray(losses))), losses
+    assert losses[-1] < losses[0], losses
