@@ -39,6 +39,13 @@ def _env(name: str, default: str = "") -> str:
     return os.getenv(name, default)
 
 
+def _env_bool(name: str, default: bool) -> bool:
+    raw = os.getenv(name)
+    if raw is None or not raw.strip():
+        return default
+    return raw.strip().lower() not in ("0", "false", "no", "off")
+
+
 def _env_int(name: str, default: int) -> int:
     raw = os.getenv(name)
     if raw is None or raw == "":
@@ -251,6 +258,10 @@ def load_config(
     cfg.embed.checkpoint_path = _env("FINCHAT_EMBED_CHECKPOINT", cfg.embed.checkpoint_path)
     cfg.embed.tokenizer_path = _env("FINCHAT_EMBED_TOKENIZER", cfg.embed.tokenizer_path)
     cfg.engine.max_seqs = _env_int("FINCHAT_MAX_SEQS", cfg.engine.max_seqs)
+    cfg.engine.warmup_on_start = _env_bool("FINCHAT_WARMUP", cfg.engine.warmup_on_start)
+    cfg.engine.ring_prefill_min_tokens = _env_int(
+        "FINCHAT_RING_PREFILL_MIN", cfg.engine.ring_prefill_min_tokens
+    )
     cfg.serve.port = _env_int("FINCHAT_PORT", cfg.serve.port)
 
     # --- optional JSON config file ---

@@ -49,3 +49,17 @@ def test_file_and_override_precedence(tmp_path):
     cfg = load_config(str(cfile), overrides={"engine.max_seqs": 16})
     assert cfg.engine.max_seqs == 16  # explicit override wins
     assert cfg.model.preset == "llama3-8b"
+
+
+def test_engine_env_readers(monkeypatch):
+    from finchat_tpu.utils.config import load_config
+
+    monkeypatch.setenv("FINCHAT_WARMUP", "0")
+    monkeypatch.setenv("FINCHAT_RING_PREFILL_MIN", "2048")
+    cfg = load_config()
+    assert cfg.engine.warmup_on_start is False
+    assert cfg.engine.ring_prefill_min_tokens == 2048
+
+    monkeypatch.setenv("FINCHAT_WARMUP", "1")
+    cfg = load_config()
+    assert cfg.engine.warmup_on_start is True
