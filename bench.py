@@ -186,23 +186,23 @@ def measure(preset: str, batch: int, prompt_len: int, steps: int, warmup: int,
     top_p = jnp.ones((batch,), jnp.float32)
     top_k = jnp.zeros((batch,), jnp.int32)
 
-    def time_decode(n_warmup: int, n_steps: int) -> float:
-        """Warmed, barriered decode timing. Sync via host fetch of the
-        sampled tokens (a [batch] int32 array): block_until_ready is not a
-        reliable execution barrier on every backend (observed no-op over
-        the TPU tunnel), while a device→host copy of the step output
-        forces the whole dependent chain."""
-        for _ in range(max(n_warmup, 1)):  # compile + steady-state warmup
-            tokens = engine.decode(active, temperature, top_p, top_k)
-        np.asarray(tokens)
+    def run_decode_barriered(n_steps: int) -> float:
+        """Barriered decode loop, returns elapsed seconds. Sync via host
+        fetch of the sampled tokens (a [batch] int32 array):
+        block_until_ready is not a reliable execution barrier on every
+        backend (observed no-op over the TPU tunnel), while a device→host
+        copy of the step output forces the whole dependent chain."""
         t0 = time.perf_counter()
         for _ in range(n_steps):
             tokens = engine.decode(active, temperature, top_p, top_k)
         np.asarray(tokens)
         return time.perf_counter() - t0
 
-    # FINCHAT_PROFILE_DIR captures a jax profiler trace of the timed region
-    # (TensorBoard/Perfetto) — the device-trace plane of utils/tracing.py.
+    run_decode_barriered(max(warmup, 1))  # compile + steady-state warmup
+
+    # FINCHAT_PROFILE_DIR captures a jax profiler trace of ONLY the timed
+    # region (warmup/compile excluded) — TensorBoard/Perfetto via the
+    # device-trace plane of utils/tracing.py.
     import contextlib
 
     profile_dir = os.environ.get("FINCHAT_PROFILE_DIR")
@@ -211,7 +211,7 @@ def measure(preset: str, batch: int, prompt_len: int, steps: int, warmup: int,
             from finchat_tpu.utils.tracing import device_trace
 
             stack.enter_context(device_trace(profile_dir))
-        elapsed = time_decode(warmup, steps)
+        elapsed = run_decode_barriered(steps)
 
     tok_s = batch * steps / elapsed
 
@@ -236,7 +236,8 @@ def measure(preset: str, batch: int, prompt_len: int, steps: int, warmup: int,
         ]
         engine.prefill_batch(long_items)
         np.asarray(engine.state.context_lens)  # barrier (incl. compiles)
-        long_elapsed = time_decode(long_warmup, long_steps)
+        run_decode_barriered(long_warmup)
+        long_elapsed = run_decode_barriered(long_steps)
         longctx = {
             "longctx_prompt_len": long_prompt_len,
             "longctx_decode_steps": long_steps,

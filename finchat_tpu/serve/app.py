@@ -137,6 +137,13 @@ class App:
         self.server.route("POST", "/transactions", self.upsert_transactions)
         self._consume_task: asyncio.Task | None = None
         self._running = False
+        # Kafka-driven concurrency: one task per in-flight message so many
+        # conversations batch onto the engine together, with a per-
+        # conversation ordering chain (same conversation stays serial —
+        # the guarantee the reference gets from partition keying + serial
+        # processing, main.py:96/138)
+        self._inflight: set[asyncio.Task] = set()
+        self._conv_tails: dict[str, asyncio.Task] = {}
 
     # --- lifespan -------------------------------------------------------
     async def start(self, serve_http: bool = True) -> None:
@@ -160,6 +167,10 @@ class App:
                 await self._consume_task
             except asyncio.CancelledError:
                 pass
+        for task in list(self._inflight):  # in-flight conversations
+            task.cancel()
+        if self._inflight:
+            await asyncio.gather(*self._inflight, return_exceptions=True)
         if self.scheduler is not None:
             await self.scheduler.stop()
         self._persist_index(force=True)
@@ -270,8 +281,9 @@ class App:
         return len(texts)
 
     # --- Kafka worker loop ----------------------------------------------
-    async def process_message(self, message) -> None:
-        message_value = json.loads(message.value().decode("utf-8"))
+    async def process_message(self, message, message_value: dict | None = None) -> None:
+        if message_value is None:
+            message_value = json.loads(message.value().decode("utf-8"))
         msg = message_value["message"]
         conversation_id = message_value["conversation_id"]
         full_message = ""
@@ -351,30 +363,87 @@ class App:
         count = await asyncio.to_thread(self._ingest_rows, user_id, rows)
         logger.info("ingested %d transactions for user %s via Kafka", count, user_id)
 
-    async def consume_messages(self) -> None:
+    async def _process_with_watchdog(
+        self, msg, message_value: dict | None, prev: asyncio.Task | None
+    ) -> None:
+        """One in-flight message: wait for the SAME conversation's previous
+        message to finish (chunk-ordering guarantee), then run under the
+        per-message watchdog (reference main.py:138-153 semantics)."""
+        if prev is not None:
+            try:
+                await asyncio.shield(prev)
+            except Exception:
+                pass  # predecessor's failure was already reported on its stream
         watchdog = self.cfg.engine.watchdog_seconds
+        try:
+            await asyncio.wait_for(
+                self.process_message(msg, message_value), timeout=watchdog
+            )
+        except asyncio.TimeoutError:
+            logger.error("Message processing timed out after %s seconds", watchdog)
+            try:
+                if message_value is not None:
+                    self.kafka.produce_error_message(
+                        AI_RESPONSE_TOPIC,
+                        message_value["conversation_id"],
+                        timeout_chunk(message_value),
+                    )
+            except Exception as e:
+                logger.error("Failed to send timeout error message: %s", e)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            logger.error("Error processing message: %s", e)
+
+    def _spawn_message_task(self, msg) -> None:
+        # parse ONCE here; process_message / the timeout path reuse the dict
+        try:
+            message_value = json.loads(msg.value().decode("utf-8"))
+            conv_id = message_value.get("conversation_id", "")
+        except Exception:
+            message_value = None  # malformed: process_message reports it
+            conv_id = ""
+        prev = self._conv_tails.get(conv_id)
+        task = asyncio.create_task(self._process_with_watchdog(msg, message_value, prev))
+        self._inflight.add(task)
+        if conv_id:
+            self._conv_tails[conv_id] = task
+
+        def _done(t: asyncio.Task, conv_id=conv_id) -> None:
+            self._inflight.discard(t)
+            if conv_id and self._conv_tails.get(conv_id) is t:
+                del self._conv_tails[conv_id]
+
+        task.add_done_callback(_done)
+
+    async def consume_messages(self) -> None:
+        """Poll Kafka and fan messages out as concurrent tasks — MANY
+        conversations in flight batch onto the engine together (the whole
+        point of the continuous-batching scheduler; the reference processes
+        one message at a time per worker, SURVEY §2.3). Backpressure: stop
+        polling while a full batch's worth of messages is already in
+        flight, so the broker's consumer group redistributes load instead
+        of this worker hoarding it."""
+        max_inflight = max(self.cfg.engine.max_seqs, 1)
         while self._running:
             try:
-                msg = self.kafka.poll_message()
+                if len(self._inflight) >= max_inflight:
+                    await asyncio.wait(
+                        set(self._inflight), return_when=asyncio.FIRST_COMPLETED
+                    )
+                    continue
+                # poll in a worker thread: the confluent backend's poll
+                # blocks up to 100 ms (librdkafka), which would stall every
+                # in-flight stream now that polling overlaps processing
+                msg = await asyncio.to_thread(self.kafka.poll_message)
                 if msg is not None and msg.topic() == TRANSACTION_UPSERT_TOPIC:
                     try:
                         await self.process_upsert(msg)
                     except Exception as e:
                         logger.error("Error ingesting transactions: %s", e)
                 elif msg is not None:
-                    try:
-                        await asyncio.wait_for(self.process_message(msg), timeout=watchdog)
-                    except asyncio.TimeoutError:
-                        logger.error("Message processing timed out after %s seconds", watchdog)
-                        try:
-                            message_value = json.loads(msg.value().decode("utf-8"))
-                            self.kafka.produce_error_message(
-                                AI_RESPONSE_TOPIC,
-                                message_value["conversation_id"],
-                                timeout_chunk(message_value),
-                            )
-                        except Exception as e:
-                            logger.error("Failed to send timeout error message: %s", e)
+                    self._spawn_message_task(msg)
+                    await asyncio.sleep(0)  # let the new task reach the engine
                 else:
                     # deferred snapshot from a debounced ingest save
                     if getattr(self, "_persist_dirty", False):
