@@ -8,9 +8,10 @@ not guessed:
   1. decode_step            — the real engine step (reference total)
   2. forward/dense          — model matmuls with a cache-less dense attention
                               callback (weights-read roofline component)
-  3. scatter_kv_chunk x L   — the per-layer KV scatter alone
+  3. kv_append / scatter xL — the in-place Pallas append vs the XLA scatter
+                              (carried-cache scan, the decode structure)
   4. paged_attention x L    — the Pallas paged kernel alone
-  5. sample                 — full-vocab sampler alone
+  5. sample                 — the sampler alone
   6. cache passthrough scan — lax.scan carrying the cache through xs->ys
                               unchanged (measures the scan's cache copy)
 
@@ -21,6 +22,11 @@ Prints one JSON line with per-component ms.
 """
 
 from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import argparse
 import json
@@ -138,48 +144,69 @@ def main() -> int:
     results["forward_dense_ms"] = timeit(
         "forward_dense", lambda: fwd_dense(engine.params, tokens1, pos1))
 
-    # 3. scatter alone, all layers (mimic the per-layer scatter inside scan)
+    # 3. KV write alone, all layers: the in-place append kernel in a
+    # carried scan (the decode path structure) vs the XLA scatter
     state = engine.state
     k_new = jnp.zeros((B, 1, config.n_kv_heads, config.head_dim), config.dtype)
     v_new = k_new
     start_pos = state.context_lens
     n_valid = active.astype(jnp.int32)
+    page_table = state.page_table
+    L = config.n_layers
+
+    from finchat_tpu.ops.kv_append import paged_kv_append
+
+    kv_new = jnp.concatenate(
+        [k_new.reshape(B, 1, -1), v_new.reshape(B, 1, -1)], axis=-1)
 
     @jax.jit
-    def scatter_all(k_pages, v_pages, k_new, v_new, page_table, start_pos, n_valid):
-        def body(carry, kv):
-            k_l, v_l = kv
-            k_l, v_l = scatter_kv_chunk(
-                k_l, v_l, k_new, v_new, page_table, start_pos, n_valid, args.page_size)
-            return carry, (k_l, v_l)
+    def append_all(k_pages, v_pages):
+        def body(carry, layer_idx):
+            k_pg, v_pg = carry
+            k_pg, v_pg = paged_kv_append(
+                kv_new, k_pg, v_pg, page_table, start_pos, n_valid,
+                layer_idx[None], page_size=args.page_size)
+            return (k_pg, v_pg), None
 
-        _, out = jax.lax.scan(body, 0, (k_pages, v_pages))
-        return out
+        (k_pg, v_pg), _ = jax.lax.scan(body, (k_pages, v_pages), jnp.arange(L))
+        return k_pg, v_pg
+
+    results["kv_append_allL_ms"] = timeit(
+        "kv_append_allL", lambda: append_all(state.k_pages, state.v_pages))
+
+    @jax.jit
+    def scatter_all(k_pages, v_pages):
+        def body(carry, layer_idx):
+            k_pg, v_pg = carry
+            k_pg, v_pg = scatter_kv_chunk(
+                k_pg, v_pg, k_new, v_new, page_table, start_pos, n_valid,
+                args.page_size, layer_idx)
+            return (k_pg, v_pg), None
+
+        (k_pg, v_pg), _ = jax.lax.scan(body, (k_pages, v_pages), jnp.arange(L))
+        return k_pg, v_pg
 
     results["scatter_allL_ms"] = timeit(
-        "scatter_allL",
-        lambda: scatter_all(state.k_pages, state.v_pages, k_new, v_new,
-                            state.page_table, start_pos, n_valid))
+        "scatter_allL", lambda: scatter_all(state.k_pages, state.v_pages))
 
     # 4. paged attention kernel alone, all layers
     q1 = jnp.zeros((B, 1, config.n_heads, config.head_dim), config.dtype)
 
     @jax.jit
-    def paged_all(q, k_pages, v_pages, page_table, start_pos, n_valid):
-        def body(carry, kv):
-            k_l, v_l = kv
+    def paged_all(q, k_pages, v_pages):
+        def body(carry, layer_idx):
             out = paged_attention(
-                q, k_l, v_l, page_table, start_pos, start_pos + n_valid,
-                page_size=args.page_size, backend=attn)
-            return carry, out
+                q, k_pages, v_pages, page_table, start_pos, start_pos + n_valid,
+                layer_idx[None], page_size=args.page_size,
+                n_kv=config.n_kv_heads, backend=attn)
+            return carry + jnp.sum(out.astype(jnp.float32)), None
 
-        _, out = jax.lax.scan(body, 0, (k_pages, v_pages))
-        return out
+        acc, _ = jax.lax.scan(body, jnp.float32(0), jnp.arange(L))
+        return acc
 
     results["paged_attn_allL_ms"] = timeit(
         "paged_attn_allL",
-        lambda: paged_all(q1, state.k_pages, state.v_pages,
-                          state.page_table, start_pos, n_valid))
+        lambda: paged_all(q1, state.k_pages, state.v_pages))
 
     # 5. sampler alone
     logits = jnp.zeros((B, config.vocab_size), jnp.float32)

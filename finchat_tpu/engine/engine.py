@@ -346,7 +346,7 @@ class InferenceEngine:
             )
 
             params = shard_params(params, llama_param_shardings(mesh))
-            state = shard_decode_state(state, mesh)
+            state = shard_decode_state(state, mesh, config.n_kv_heads)
         self.params = params
         self.state = state
 

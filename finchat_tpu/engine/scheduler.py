@@ -143,6 +143,17 @@ class ContinuousBatchingScheduler:
                 f"sequence {seq_id}: prompt {len(prompt_ids)} + max_new "
                 f"{sampling.max_new_tokens} exceeds max length {max_len}"
             )
+        from finchat_tpu.engine.sampler import CANDIDATES
+
+        if sampling.top_k > CANDIDATES:
+            logger.warning(
+                "sequence %s: top_k=%d exceeds the sampler candidate cap %d; clamping "
+                "(see SamplingParams truncation contract)",
+                seq_id, sampling.top_k, CANDIDATES,
+            )
+            import dataclasses as _dc
+
+            sampling = _dc.replace(sampling, top_k=CANDIDATES)
         handle = SequenceHandle(
             seq_id=seq_id, prompt_ids=list(prompt_ids), sampling=sampling, constraint=constraint
         )
@@ -233,6 +244,13 @@ class ContinuousBatchingScheduler:
             try:
                 inject("scheduler.prefill", seq_id=handle.seq_id)
                 if handle.prefill_pos == 0 and eng._use_ring_prefill(len(handle.prompt_ids)):
+                    # LATENCY TRADE: the ring prefill is one monolithic
+                    # device program — in-flight decode streams stall for
+                    # its full duration (the chunked path interleaves a
+                    # decode step per chunk). ring_prefill_min_tokens must
+                    # be set so that stall is acceptable; the ring path
+                    # buys O(S/seq) per-device activations for prompts the
+                    # chunked path cannot fit. Chunked-ring is future work.
                     with Timer(METRICS, "finchat_prefill_seconds"):
                         ring_logits = eng.prefill_ring(handle.slot, handle.prompt_ids)
                     handle.prefill_pos = len(handle.prompt_ids)

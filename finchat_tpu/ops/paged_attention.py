@@ -50,6 +50,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from finchat_tpu.ops.flash_attention import (
     NEG_INF,
+    _online_softmax_update,
     _pick_block,
     _round_up,
 )
@@ -115,24 +116,10 @@ def _paged_kernel(
             v_blk = v_ref[0, 0, :, h * D:(h + 1) * D]
             r0 = h * Rh
 
-            s = jax.lax.dot_general(
-                q_blk, k_blk, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            ) * scale
-            s = jnp.where(invalid, NEG_INF, s)
-            m_prev = m_scr[r0:r0 + Rh, :1]
-            l_prev = l_scr[r0:r0 + Rh, :1]
-            m_cur = jnp.max(s, axis=-1, keepdims=True)
-            m_new = jnp.maximum(m_prev, m_cur)
-            # explicit zeroing: rows whose every logit is masked have
-            # m_new = NEG_INF and exp(s - m_new) = 1 there — the mask, not
-            # the exp, must decide
-            pr = jnp.where(invalid, 0.0, jnp.exp(s - m_new))
-            corr = jnp.exp(m_prev - m_new)
-            l_new = l_prev * corr + jnp.sum(pr, axis=-1, keepdims=True)
-            acc_new = acc_scr[r0:r0 + Rh] * corr + jax.lax.dot_general(
-                pr.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
+            m_new, l_new, acc_new = _online_softmax_update(
+                q_blk, k_blk, v_blk, invalid,
+                m_scr[r0:r0 + Rh, :1], l_scr[r0:r0 + Rh, :1],
+                acc_scr[r0:r0 + Rh], scale,
             )
             m_scr[r0:r0 + Rh, :1] = m_new
             l_scr[r0:r0 + Rh, :1] = l_new

@@ -66,18 +66,35 @@ def llama_param_shardings(mesh: Mesh) -> dict[str, Any]:
     }
 
 
-def decode_state_shardings(mesh: Mesh) -> dict[str, Any]:
-    """Shardings for engine.DecodeState fields (see engine/engine.py)."""
+def decode_state_shardings(mesh: Mesh, n_kv_heads: int | None = None) -> dict[str, Any]:
+    """Shardings for engine.DecodeState fields (see engine/engine.py).
+
+    ``n_kv_heads`` guards the fused-dim split: sharding [.., Hkv*hd] on
+    ``model`` is only a whole-KV-head split (the locality the Pallas paged
+    kernel's per-head value slices rely on) when the model axis divides
+    Hkv. The fused dim often divides NUMERICALLY even when the head count
+    doesn't (model=8, Hkv=4, hd=64 → 256/8 splits mid-head), so divisibility
+    of the byte count is not enough — pass the head count and the pages
+    replicate when it doesn't divide."""
     def ns(*spec):
         return NamedSharding(mesh, P(*spec))
 
+    kv_whole_heads = (
+        n_kv_heads is None or n_kv_heads % mesh.shape.get("model", 1) == 0
+    )
+    if not kv_whole_heads:
+        logger.warning(
+            "model axis %d does not divide n_kv_heads %s; replicating KV pages",
+            mesh.shape.get("model", 1), n_kv_heads,
+        )
+    kv_spec = ns(None, None, None, "model") if kv_whole_heads else ns(None, None, None, None)
     return {
         # [L, pages, page_size, Hkv*hd] — the fused KV-head dim on the model
         # axis (head-major within the fused dim, so a model-axis shard is a
         # whole number of KV heads — matching the k/v projection sharding,
         # keeping cache writes local)
-        "k_pages": ns(None, None, None, "model"),
-        "v_pages": ns(None, None, None, "model"),
+        "k_pages": kv_spec,
+        "v_pages": kv_spec,
         "page_table": ns(None, None),
         "context_lens": ns(None),
         "last_tokens": ns(None),
@@ -144,11 +161,11 @@ def shard_params(params: dict[str, Any], shardings: dict[str, Any]) -> dict[str,
     )
 
 
-def shard_decode_state(state, mesh: Mesh):
+def shard_decode_state(state, mesh: Mesh, n_kv_heads: int | None = None):
     """Place an engine DecodeState onto the mesh."""
     import dataclasses
 
-    sh = decode_state_shardings(mesh)
+    sh = decode_state_shardings(mesh, n_kv_heads)
     return dataclasses.replace(
         state,
         **{
