@@ -62,6 +62,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--attn", choices=("pallas", "ref", "pallas-interpret"),
                    default=None, help="attention backend (default: resolve "
                    "FINCHAT_ATTN / platform in the worker)")
+    p.add_argument("--quant", choices=("int8",), default=None,
+                   help="serve int8 weight-only quantized params "
+                        "(models/quant.py); default bf16")
     p.add_argument("--tpu-timeout", type=float, default=180.0,
                    help="seconds allowed for TPU backend INIT before the "
                         "child is declared hung (measurement gets "
@@ -113,14 +116,15 @@ def run_worker(args: argparse.Namespace) -> int:
     faulthandler.dump_traceback_later(max(60.0, args.measure_budget - 10.0), exit=True)
 
     work = resolve_workload(args, "tpu" if platform == "tpu" else "cpu")
-    result = measure(attn=args.attn, **work)
+    result = measure(attn=args.attn, quant=args.quant or "", **work)
     result["backend_init_s"] = round(init_s, 1)
     print(json.dumps(result), flush=True)
     return 0
 
 
 def measure(preset: str, batch: int, prompt_len: int, steps: int, warmup: int,
-            page_size: int, max_seq_len: int, attn: str | None) -> dict:
+            page_size: int, max_seq_len: int, attn: str | None,
+            quant: str = "") -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -144,7 +148,8 @@ def measure(preset: str, batch: int, prompt_len: int, steps: int, warmup: int,
     )
 
     params = init_params(config, jax.random.key(0))
-    engine = InferenceEngine(config, params, engine_cfg, attn_backend=attn)
+    engine = InferenceEngine(config, params, engine_cfg, attn_backend=attn,
+                             quant=quant)
 
     # assign pages + prefill a random prompt into every slot — all slots
     # batched into one prefill_step round (one weights-read per chunk round
@@ -252,6 +257,7 @@ def measure(preset: str, batch: int, prompt_len: int, steps: int, warmup: int,
         "vs_baseline": round(tok_s / BASELINE_TOK_S_PER_CHIP, 3),
         "model": preset,
         "attn": attn,
+        "quant": quant or "bf16",
         "batch": batch,
         "prompt_len": prompt_len,
         "decode_steps": steps,
@@ -274,7 +280,7 @@ def spawn_worker(args: argparse.Namespace, platform: str, timeout: float) -> dic
            "--platform", platform, "--tpu-timeout", str(args.tpu_timeout),
            "--measure-budget", str(args.measure_budget)]
     for flag in ("preset", "batch", "prompt_len", "steps", "warmup",
-                 "page_size", "max_seq_len", "attn"):
+                 "page_size", "max_seq_len", "attn", "quant"):
         v = getattr(args, flag)
         if v is not None:
             cmd += ["--" + flag.replace("_", "-"), str(v)]

@@ -322,9 +322,11 @@ class InferenceEngine:
     """
 
     def __init__(self, config: LlamaConfig, params: dict[str, Any], engine_cfg: EngineConfig,
-                 mesh=None, attn_backend: str | None = None):
+                 mesh=None, attn_backend: str | None = None, quant: str = ""):
         from finchat_tpu.ops.dispatch import attention_backend
 
+        if quant and quant != "int8":
+            raise ValueError(f"unknown quant mode {quant!r} (supported: 'int8')")
         self.config = config
         self.attn_backend = attn_backend or attention_backend()
         self.engine_cfg = engine_cfg
@@ -347,6 +349,13 @@ class InferenceEngine:
 
             params = shard_params(params, llama_param_shardings(mesh))
             state = shard_decode_state(state, mesh, config.n_kv_heads)
+        if quant:
+            # after sharding on purpose: quantize is plain jnp, so q/scale
+            # inherit each weight's GSPMD placement (models/quant.py)
+            from finchat_tpu.models.quant import quantize_llama_params
+
+            params = quantize_llama_params(params)
+        self.quant = quant
         self.params = params
         self.state = state
 

@@ -25,6 +25,8 @@ import jax
 import jax.numpy as jnp
 from jax import Array, lax
 
+from finchat_tpu.models.quant import QTensor, dense, dequantize
+
 # attention callback signature:
 #   fn(q[B,S,H,D], k[B,S,Hkv,D], v[B,S,Hkv,D], layer_cache, layer_idx) ->
 #   (out[B,S,H,D], new_layer_cache)
@@ -97,18 +99,18 @@ def init_params(config: LlamaConfig, key: Array) -> dict[str, Any]:
     c = config
     k_embed, k_layers, k_head = jax.random.split(key, 3)
 
-    def dense(k: Array, shape: tuple[int, ...], fan_in: int) -> Array:
+    def rand_init(k: Array, shape: tuple[int, ...], fan_in: int) -> Array:
         return (jax.random.normal(k, shape, jnp.float32) * fan_in ** -0.5).astype(c.dtype)
 
     keys = jax.random.split(k_layers, 8)
     L, D, H, Hkv, hd, F = c.n_layers, c.dim, c.n_heads, c.n_kv_heads, c.head_dim, c.hidden_dim
     params: dict[str, Any] = {
-        "embed": dense(k_embed, (c.vocab_size, D), D),
+        "embed": rand_init(k_embed, (c.vocab_size, D), D),
         "layers": {
-            "attn_q": dense(keys[0], (L, D, H * hd), D),
-            "attn_k": dense(keys[1], (L, D, Hkv * hd), D),
-            "attn_v": dense(keys[2], (L, D, Hkv * hd), D),
-            "attn_o": dense(keys[3], (L, H * hd, D), H * hd),
+            "attn_q": rand_init(keys[0], (L, D, H * hd), D),
+            "attn_k": rand_init(keys[1], (L, D, Hkv * hd), D),
+            "attn_v": rand_init(keys[2], (L, D, Hkv * hd), D),
+            "attn_o": rand_init(keys[3], (L, H * hd, D), H * hd),
             "ln_attn": jnp.ones((L, D), c.dtype),
             "ln_mlp": jnp.ones((L, D), c.dtype),
         },
@@ -120,21 +122,21 @@ def init_params(config: LlamaConfig, key: Array) -> dict[str, Any]:
             {
                 # router stays fp32: routing is precision-sensitive, tiny
                 "router": jax.random.normal(keys[7], (L, D, E), jnp.float32) * D ** -0.5,
-                "moe_gate": dense(keys[4], (L, E, D, F), D),
-                "moe_up": dense(keys[5], (L, E, D, F), D),
-                "moe_down": dense(keys[6], (L, E, F, D), F),
+                "moe_gate": rand_init(keys[4], (L, E, D, F), D),
+                "moe_up": rand_init(keys[5], (L, E, D, F), D),
+                "moe_down": rand_init(keys[6], (L, E, F, D), F),
             }
         )
     else:
         params["layers"].update(
             {
-                "mlp_gate": dense(keys[4], (L, D, F), D),
-                "mlp_up": dense(keys[5], (L, D, F), D),
-                "mlp_down": dense(keys[6], (L, F, D), F),
+                "mlp_gate": rand_init(keys[4], (L, D, F), D),
+                "mlp_up": rand_init(keys[5], (L, D, F), D),
+                "mlp_down": rand_init(keys[6], (L, F, D), F),
             }
         )
     if not c.tie_embeddings:
-        params["lm_head"] = dense(k_head, (D, c.vocab_size), D)
+        params["lm_head"] = rand_init(k_head, (D, c.vocab_size), D)
     return params
 
 
@@ -183,11 +185,17 @@ def moe_mlp(h: Array, layer_params: dict[str, Array], config: LlamaConfig) -> Ar
     onehot = jax.nn.one_hot(top_idx, E, dtype=w.dtype)  # [B,S,k,E]
     gates = jnp.einsum("bske,bsk->bse", onehot, w).astype(h.dtype)  # [B,S,E]
 
-    gate = jnp.einsum("bsd,edf->bsef", h, layer_params["moe_gate"])
-    up = jnp.einsum("bsd,edf->bsef", h, layer_params["moe_up"])
+    def expert_mm(spec: str, x: Array, w: Array | QTensor) -> Array:
+        # int8 serving: inline dequant, fused into the dot's operand read
+        if isinstance(w, QTensor):
+            w = dequantize(w, x.dtype)
+        return jnp.einsum(spec, x, w)
+
+    gate = expert_mm("bsd,edf->bsef", h, layer_params["moe_gate"])
+    up = expert_mm("bsd,edf->bsef", h, layer_params["moe_up"])
     act = jax.nn.silu(gate.astype(jnp.float32)).astype(up.dtype) * up
     act = act * gates[..., None]  # zero non-routed experts pre-projection
-    return jnp.einsum("bsef,efd->bsd", act, layer_params["moe_down"])
+    return expert_mm("bsef,efd->bsd", act, layer_params["moe_down"])
 
 
 def _layer(
@@ -204,22 +212,22 @@ def _layer(
     B, S, D = x.shape
 
     h = rms_norm(x, layer_params["ln_attn"], c.norm_eps)
-    q = (h @ layer_params["attn_q"]).reshape(B, S, c.n_heads, c.head_dim)
-    k = (h @ layer_params["attn_k"]).reshape(B, S, c.n_kv_heads, c.head_dim)
-    v = (h @ layer_params["attn_v"]).reshape(B, S, c.n_kv_heads, c.head_dim)
+    q = dense(h, layer_params["attn_q"]).reshape(B, S, c.n_heads, c.head_dim)
+    k = dense(h, layer_params["attn_k"]).reshape(B, S, c.n_kv_heads, c.head_dim)
+    v = dense(h, layer_params["attn_v"]).reshape(B, S, c.n_kv_heads, c.head_dim)
     q = rope(q, positions, c.rope_theta)
     k = rope(k, positions, c.rope_theta)
 
     attn_out, new_layer_cache = attention(q, k, v, layer_cache, layer_idx)
-    x = x + (attn_out.reshape(B, S, -1) @ layer_params["attn_o"])
+    x = x + dense(attn_out.reshape(B, S, -1), layer_params["attn_o"])
 
     h = rms_norm(x, layer_params["ln_mlp"], c.norm_eps)
     if c.n_experts:
         x = x + moe_mlp(h, layer_params, c)
     else:
-        gate = h @ layer_params["mlp_gate"]
-        up = h @ layer_params["mlp_up"]
-        x = x + ((jax.nn.silu(gate.astype(jnp.float32)).astype(up.dtype) * up) @ layer_params["mlp_down"])
+        gate = dense(h, layer_params["mlp_gate"])
+        up = dense(h, layer_params["mlp_up"])
+        x = x + dense(jax.nn.silu(gate.astype(jnp.float32)).astype(up.dtype) * up, layer_params["mlp_down"])
     return x, new_layer_cache
 
 
@@ -277,6 +285,8 @@ def forward(
 def lm_head(params: dict[str, Any], x: Array, *, config: LlamaConfig) -> Array:
     """Project hidden states [..., D] to fp32 logits [..., vocab]."""
     head = params["embed"].T if config.tie_embeddings else params["lm_head"]
+    if isinstance(head, QTensor):
+        head = dequantize(head, x.dtype)
     return jnp.einsum("...d,dv->...v", x, head, preferred_element_type=jnp.float32)
 
 

@@ -1,0 +1,106 @@
+"""Int8 weight-only quantization for serving.
+
+No reference counterpart (the reference calls an external LLM API —
+``llm_agent.py:34-45``); this exists because the measured decode step is
+weight-READ-bound on TPU (PERF_r04.md attribution: ~6 ms of the 9.6 ms
+step is the dense forward streaming bf16 weights from HBM). Storing matmul
+weights as int8 with per-output-channel scales halves that traffic; the
+MXU still computes in bf16 (int8 values up to ±127 are exact in bf16), so
+the only numeric change is the weight rounding itself — bounded by the
+per-channel max / 127 and asserted in tests/test_quant.py.
+
+Design notes (TPU/JAX-first):
+- ``QTensor`` is a registered pytree dataclass, so quantized leaves ride
+  ``lax.scan`` over stacked layers, jit boundaries, and GSPMD sharding
+  exactly like plain arrays. Scanning slices ``q[L, K, N] -> [K, N]`` and
+  ``scale[L, N] -> [N]`` together.
+- Scales are per-OUTPUT-column (the non-contracted axis). Matmul sites
+  dequantize INLINE (``x @ (q * s)``): inside jit XLA fuses the
+  upcast+scale into the dot's operand read, so HBM still streams int8
+  while the MXU computes bf16. Post-matmul scaling (``(x @ q) * s``) is
+  mathematically equal but NOT used: under row-parallel TP it reorders
+  the scale past the partial-sum psum, whose bf16 rounding then differs
+  from the single-device result — inline dequant keeps TP decode
+  bit-identical to unsharded (tests/test_quant.py).
+- Quantize AFTER ``shard_params``: ``quantize`` is plain jnp, so on
+  GSPMD-sharded inputs the amax reduce runs over the (replicated)
+  contraction axis per shard and ``q``/``scale`` inherit the weight's
+  placement — no parallel spec bookkeeping for the quantized tree.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+# layer-stack leaves that are matmul weights [., K, N] (contract over -2);
+# norms and the (precision-sensitive, tiny) MoE router stay full precision
+QUANT_LAYER_LEAVES = frozenset({
+    "attn_q", "attn_k", "attn_v", "attn_o",
+    "mlp_gate", "mlp_up", "mlp_down",
+    "moe_gate", "moe_up", "moe_down",
+})
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class QTensor:
+    """Int8 weight + per-output-column scale for right-multiplication.
+
+    ``q``: int8 ``[..., K, N]``; ``scale``: fp32 ``[..., N]`` such that the
+    represented weight is ``q * scale[..., None, :]``.
+    """
+
+    q: Array
+    scale: Array
+
+    @property
+    def shape(self) -> tuple[int, ...]:
+        return self.q.shape
+
+    @property
+    def ndim(self) -> int:
+        return self.q.ndim
+
+
+def quantize(w: Array) -> QTensor:
+    """Symmetric int8 per-output-column quantization of ``w[..., K, N]``."""
+    w32 = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(w32), axis=-2)  # [..., N]
+    scale = jnp.where(amax > 0, amax, 1.0) / 127.0
+    q = jnp.clip(jnp.round(w32 / scale[..., None, :]), -127, 127).astype(jnp.int8)
+    return QTensor(q=q, scale=scale)
+
+
+def dequantize(qt: QTensor, dtype: Any = jnp.bfloat16) -> Array:
+    """Materialize the represented weight. Inside jit, XLA fuses the
+    upcast+scale into the consuming dot's operand read — used at einsum
+    sites where the scale cannot commute past a summed axis."""
+    return (qt.q.astype(jnp.float32) * qt.scale[..., None, :]).astype(dtype)
+
+
+def dense(x: Array, w: Array | QTensor) -> Array:
+    """``x @ w`` for a plain or quantized weight (inline dequantization —
+    see the module docstring for why not post-matmul scaling)."""
+    if isinstance(w, QTensor):
+        return x @ dequantize(w, x.dtype)
+    return x @ w
+
+
+def quantize_llama_params(params: dict[str, Any]) -> dict[str, Any]:
+    """Quantize a Llama/Mixtral param tree's matmul weights in place of the
+    bf16 leaves (models/llama.py layout). Embedding (a gather, not a
+    matmul), norms, and the MoE router stay full precision; ``lm_head`` is
+    quantized when present (tied-embedding models keep the dense path)."""
+    layers = {
+        name: quantize(leaf) if name in QUANT_LAYER_LEAVES else leaf
+        for name, leaf in params["layers"].items()
+    }
+    out = {**params, "layers": layers}
+    if "lm_head" in params:
+        out["lm_head"] = quantize(params["lm_head"])
+    return out

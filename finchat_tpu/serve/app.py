@@ -109,7 +109,8 @@ def build_generators(cfg: AppConfig) -> tuple[TextGenerator, TextGenerator, Cont
     # on a multi-chip host, and a 4-chip mesh config works on an 8-chip host)
     n_mesh = jax.device_count() if -1 in sizes else fixed
     mesh = build_mesh(spec, devices=jax.devices()[:n_mesh]) if n_mesh > 1 else None
-    engine = InferenceEngine(config, params, cfg.engine, mesh=mesh)
+    engine = InferenceEngine(config, params, cfg.engine, mesh=mesh,
+                             quant=cfg.model.quant)
     if cfg.engine.warmup_on_start:
         engine.warmup()
     scheduler = ContinuousBatchingScheduler(engine, eos_id=tokenizer.eos_id)

@@ -133,6 +133,7 @@ class ModelConfig:
     tokenizer_path: str = ""  # HF tokenizer dir; empty = byte tokenizer
     dtype: str = "bfloat16"
     seed: int = 0
+    quant: str = ""  # "" (bf16) | "int8" weight-only serving (models/quant.py)
 
 
 @dataclass
@@ -255,6 +256,7 @@ def load_config(
     cfg.model.preset = _env("FINCHAT_MODEL_PRESET", cfg.model.preset)
     cfg.model.checkpoint_path = _env("FINCHAT_CHECKPOINT", cfg.model.checkpoint_path)
     cfg.model.tokenizer_path = _env("FINCHAT_TOKENIZER", cfg.model.tokenizer_path)
+    cfg.model.quant = _env("FINCHAT_QUANT", cfg.model.quant)
     cfg.embed.checkpoint_path = _env("FINCHAT_EMBED_CHECKPOINT", cfg.embed.checkpoint_path)
     cfg.embed.tokenizer_path = _env("FINCHAT_EMBED_TOKENIZER", cfg.embed.tokenizer_path)
     cfg.engine.max_seqs = _env_int("FINCHAT_MAX_SEQS", cfg.engine.max_seqs)

@@ -1,0 +1,162 @@
+"""Int8 weight-only quantization (models/quant.py) correctness.
+
+No reference counterpart (the reference has no in-process model); the
+contract tested here is the one serving relies on: the rounding error is
+bounded per channel, the post-matmul scale is EXACTLY the dequantized
+matmul, quantized logits track bf16 logits, and the quantized engine is
+deterministic and TP-invariant like the bf16 engine (tests/test_parallel.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from finchat_tpu.engine.engine import InferenceEngine, commit_first_token
+from finchat_tpu.engine.kv_cache import PageAllocator, pages_needed
+from finchat_tpu.models.llama import LlamaConfig, PRESETS, forward_full, init_params
+from finchat_tpu.models.quant import (
+    QTensor,
+    dense,
+    dequantize,
+    quantize,
+    quantize_llama_params,
+)
+from finchat_tpu.utils.config import EngineConfig
+
+
+def test_quantize_roundtrip_error_bound():
+    w = jax.random.normal(jax.random.key(0), (64, 96), jnp.float32)
+    qt = quantize(w)
+    assert qt.q.dtype == jnp.int8 and qt.scale.shape == (96,)
+    # round-to-nearest: per-element error <= half a quantization step
+    err = jnp.abs(dequantize(qt, jnp.float32) - w)
+    assert float((err - qt.scale[None, :] / 2).max()) < 1e-6
+
+
+def test_quantize_zero_column_safe():
+    w = jnp.zeros((8, 4), jnp.float32).at[:, 0].set(1.0)
+    qt = quantize(w)
+    assert np.isfinite(np.asarray(qt.scale)).all()
+    np.testing.assert_allclose(np.asarray(dequantize(qt, jnp.float32)), np.asarray(w))
+
+
+def test_post_matmul_scale_exact():
+    """dense(x, qt) must equal x @ dequantize(qt): per-output-column scales
+    commute out of the dot."""
+    kx, kw = jax.random.split(jax.random.key(1))
+    x = jax.random.normal(kx, (4, 32), jnp.float32)
+    qt = quantize(jax.random.normal(kw, (32, 16), jnp.float32))
+    got = dense(x, qt)
+    want = x @ dequantize(qt, jnp.float32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
+def test_stacked_layer_quantize_slices_with_scan():
+    """QTensor leaves must slice per-layer under lax.scan like plain stacked
+    weights: quantizing the stack == quantizing each layer independently."""
+    w = jax.random.normal(jax.random.key(2), (3, 16, 8), jnp.float32)
+    stacked = quantize(w)
+    for layer in range(3):
+        per_layer = quantize(w[layer])
+        np.testing.assert_array_equal(np.asarray(stacked.q[layer]), np.asarray(per_layer.q))
+        np.testing.assert_allclose(np.asarray(stacked.scale[layer]), np.asarray(per_layer.scale))
+
+
+@pytest.mark.parametrize("preset", ["tiny", "moe-tiny"])
+def test_forward_logits_track_bf16(preset):
+    config = PRESETS[preset]
+    params = init_params(config, jax.random.key(0))
+    qparams = quantize_llama_params(params)
+    # norms, embed, and router are untouched; matmul weights are QTensor
+    assert isinstance(qparams["layers"]["attn_q"], QTensor)
+    assert not isinstance(qparams["layers"]["ln_attn"], QTensor)
+    assert not isinstance(qparams["embed"], QTensor)
+    if config.n_experts:
+        assert not isinstance(qparams["layers"]["router"], QTensor)
+
+    tokens = jax.random.randint(jax.random.key(3), (2, 16), 1, config.vocab_size)
+    positions = jnp.broadcast_to(jnp.arange(16)[None], (2, 16))
+    ref = forward_full(params, tokens, positions, config=config)
+    got = forward_full(qparams, tokens, positions, config=config)
+    rel = float(jnp.linalg.norm(got - ref) / jnp.linalg.norm(ref))
+    assert rel < 0.08, f"quantized logits diverged: rel err {rel:.3f}"
+
+
+def test_tied_embeddings_keep_dense_head():
+    config = LlamaConfig(tie_embeddings=True)
+    qparams = quantize_llama_params(init_params(config, jax.random.key(0)))
+    assert "lm_head" not in qparams and not isinstance(qparams["embed"], QTensor)
+    tokens = jnp.ones((1, 4), jnp.int32)
+    positions = jnp.arange(4)[None]
+    logits = forward_full(qparams, tokens, positions, config=config)
+    assert logits.shape == (1, 4, config.vocab_size)
+
+
+def test_engine_rejects_unknown_quant_mode():
+    config = PRESETS["tiny"]
+    ecfg = EngineConfig(max_seqs=2, page_size=8, num_pages=16, max_seq_len=64, prefill_chunk=8)
+    with pytest.raises(ValueError, match="unknown quant mode"):
+        InferenceEngine(config, init_params(config, jax.random.key(0)), ecfg, quant="fp4")
+
+
+def _engine_greedy(eng, prompt, n_new):
+    alloc = PageAllocator(eng.engine_cfg.num_pages)
+    pages = alloc.allocate("s", pages_needed(len(prompt) + n_new, eng.page_size))
+    eng.set_page_table_row(0, pages)
+    logits = eng.prefill(0, prompt)
+    eng.state, tok = commit_first_token(
+        eng.state, jnp.int32(0), logits, jnp.float32(0.0), jnp.float32(1.0), jnp.int32(0)
+    )
+    out = [int(tok)]
+    B = eng.engine_cfg.max_seqs
+    active = jnp.zeros((B,), bool).at[0].set(True)
+    z, o, zk = jnp.zeros((B,)), jnp.ones((B,)), jnp.zeros((B,), jnp.int32)
+    for _ in range(n_new - 1):
+        out.append(int(eng.decode(active, z, o, zk)[0]))
+    return out
+
+
+def test_quantized_engine_matches_quantized_oracle():
+    """Paged-engine decode over QTensor params must reproduce the naive
+    full-forward greedy decode over the SAME quantized params — the golden
+    decode contract (tests/test_engine.py) holds under quantization."""
+    config = PRESETS["tiny"]
+    params = init_params(config, jax.random.key(0))
+    ecfg = EngineConfig(max_seqs=2, page_size=8, num_pages=32, max_seq_len=64, prefill_chunk=8)
+    eng = InferenceEngine(config, params, ecfg, quant="int8")
+    prompt, n_new = [5, 9, 2, 100, 17, 3], 6
+
+    qparams = quantize_llama_params(params)
+    seq, want = list(prompt), []
+    pad = 32
+    positions = jnp.arange(pad)[None]
+    for _ in range(n_new):
+        tokens = jnp.asarray(seq + [0] * (pad - len(seq)), jnp.int32)[None]
+        logits = forward_full(qparams, tokens, positions, config=config)
+        nxt = int(jnp.argmax(logits[0, len(seq) - 1]))
+        want.append(nxt)
+        seq.append(nxt)
+
+    assert _engine_greedy(eng, prompt, n_new) == want
+
+
+def test_tp_quantized_engine_matches_unsharded():
+    """Quantize-after-shard (engine/engine.py) must not change the tokens:
+    TP=8 int8 greedy decode == single-device int8 greedy decode."""
+    from finchat_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    config = LlamaConfig(
+        vocab_size=128, dim=64, n_layers=2, n_heads=8, n_kv_heads=8,
+        hidden_dim=128, max_seq_len=64,
+    )
+    params = init_params(config, jax.random.key(0))
+    ecfg = EngineConfig(max_seqs=2, page_size=8, num_pages=16, max_seq_len=64, prefill_chunk=8)
+    prompt, n_new = [5, 9, 2, 100, 17, 3], 6
+
+    unsharded = _engine_greedy(
+        InferenceEngine(config, params, ecfg, quant="int8"), prompt, n_new)
+    tp_mesh = build_mesh(MeshSpec(data=1, seq=1, expert=1, model=8))
+    sharded = _engine_greedy(
+        InferenceEngine(config, params, ecfg, mesh=tp_mesh, quant="int8"), prompt, n_new)
+    assert unsharded == sharded
