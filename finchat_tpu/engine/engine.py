@@ -83,6 +83,7 @@ def create_state(
 def _paged_attention_fn(
     page_table: Array, start_pos: Array, n_valid: Array,
     page_size: int, n_kv: int, attn_backend: str,
+    inplace_append: bool = False,
 ):
     """Build the model's attention callback for paged prefill/decode.
 
@@ -90,6 +91,12 @@ def _paged_attention_fn(
     the first query token), ``n_valid`` [B] (real tokens in this chunk; 0
     for inactive decode slots). The callback receives the FULL-depth cache
     (carried through the layer scan) plus the layer index.
+
+    ``inplace_append`` forces the in-place page-RMW write path for C > 1
+    (one single-token append per chunk position) — used by the speculative
+    verify step, whose few-token chunks would otherwise pay the scatter's
+    full-cache copy every step, exactly what the append kernel exists to
+    avoid.
     """
     interpret = True if attn_backend == "pallas-interpret" else None
 
@@ -99,18 +106,22 @@ def _paged_attention_fn(
         k_pages, v_pages = cache
         B, C = k.shape[:2]
         layer = layer_idx.reshape(1)
-        if C == 1 and attn_backend != "ref":
-            # decode: in-place single-page RMW append (no cache copy)
+        if (C == 1 or inplace_append) and attn_backend != "ref":
+            # decode / spec verify: in-place single-page RMW appends (no
+            # cache copy); token i of the chunk is valid iff i < n_valid
             from finchat_tpu.ops.kv_append import paged_kv_append
 
             with named_scope("kv_append"):
-                kv_new = jnp.concatenate(
-                    [k.reshape(B, 1, -1), v.reshape(B, 1, -1)], axis=-1
-                )
-                k_pages, v_pages = paged_kv_append(
-                    kv_new, k_pages, v_pages, page_table, start_pos, n_valid,
-                    layer, page_size=page_size, interpret=interpret,
-                )
+                for i in range(C):
+                    kv_new = jnp.concatenate(
+                        [k[:, i].reshape(B, 1, -1), v[:, i].reshape(B, 1, -1)],
+                        axis=-1,
+                    )
+                    k_pages, v_pages = paged_kv_append(
+                        kv_new, k_pages, v_pages, page_table, start_pos + i,
+                        (i < n_valid).astype(jnp.int32),
+                        layer, page_size=page_size, interpret=interpret,
+                    )
         else:
             # prefill chunk (or jnp reference path): XLA scatter — one
             # cache copy amortized over the whole batched chunk
@@ -311,6 +322,91 @@ def decode_step(
         rng=rng,
     )
     return new_state, next_tokens, (step_logits if return_logits else None)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("config", "page_size", "attn_backend", "return_logits"),
+    donate_argnums=(1,),
+)
+def verify_step(
+    params: dict[str, Any],
+    state: DecodeState,
+    active: Array,  # [max_seqs] bool
+    drafts: Array,  # [max_seqs, Kd] int32 — host-proposed draft tokens
+    n_drafts: Array,  # [max_seqs] int32 — live drafts per slot (0 = plain decode)
+    temperature: Array,  # [max_seqs]
+    top_p: Array,  # [max_seqs]
+    top_k: Array,  # [max_seqs] int32
+    *,
+    config: LlamaConfig,
+    page_size: int,
+    attn_backend: str = "ref",
+    return_logits: bool = False,
+) -> tuple[DecodeState, Array, Array, Array | None]:
+    """Speculative-decoding verify step (prompt-lookup style): one forward
+    over ``[last_token, draft_1..draft_Kd]`` per slot scores every draft in
+    a single weights-read; the accepted prefix plus one model token commit
+    together. Returns ``(state, emitted [B, K], n_emitted [B], logits?)``
+    where ``K = Kd + 1`` and ``emitted[b, :n_emitted[b]]`` are the tokens
+    produced this step (1..K per slot).
+
+    Greedy-exactness contract (tests/test_spec_decode.py): for a greedy
+    slot the emitted stream is IDENTICAL to running ``decode_step``
+    token-by-token — draft i is accepted iff it equals the argmax at its
+    position, and position i's scores attend only to positions <= i (the
+    paged kernel's causal mask), so acceptance never changes a token, only
+    how many commit per step. Rejected drafts' KV lands beyond the new
+    ``context_lens`` — masked by every future step and overwritten when
+    those positions are reached for real.
+
+    Non-greedy and grammar-constrained slots ride with ``n_drafts = 0``:
+    their single token is sampled from position-0 logits with the full
+    sampler (bit-identical math to ``decode_step``), and
+    ``return_logits=True`` hands position-0 logits to the host for
+    constrained picks, as in ``decode_step``.
+    """
+    B, Kd = drafts.shape
+    tokens = jnp.concatenate([state.last_tokens[:, None], drafts], axis=1)  # [B, K]
+    K = Kd + 1
+    positions = state.context_lens[:, None] + jnp.arange(K)[None, :]
+    n_valid = jnp.where(active, 1 + n_drafts, 0)  # [B] tokens whose KV is written
+
+    attention = _paged_attention_fn(
+        state.page_table, state.context_lens, n_valid,
+        page_size, config.n_kv_heads, attn_backend, inplace_append=True,
+    )
+    logits, (k_pages, v_pages) = forward(
+        params, tokens, positions,
+        config=config, attention=attention,
+        cache=(state.k_pages, state.v_pages),
+    )  # [B, K, vocab]
+
+    preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, K]
+    # draft column i (1..Kd) is accepted while every earlier draft matched
+    # and it equals the model's prediction for its position
+    col = jnp.arange(1, K)[None, :]  # [1, Kd]
+    match = (col <= n_drafts[:, None]) & (tokens[:, 1:] == preds[:, :-1])
+    accepted = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(axis=1)  # [B]
+    n_emitted = jnp.where(active, accepted + 1, 0)
+
+    # non-greedy slots (always draft-free) sample position 0 with the full
+    # sampler — same math and rng discipline as decode_step
+    rng, sub = jax.random.split(state.rng)
+    step_logits = logits[:, 0, :]  # [B, vocab] fp32
+    sampled0 = sample(step_logits, sub, temperature, top_p, top_k)
+    emitted = jnp.concatenate([sampled0[:, None], preds[:, 1:]], axis=1)  # [B, K]
+    last = jnp.take_along_axis(emitted, accepted[:, None], axis=1)[:, 0]
+
+    new_state = dataclasses.replace(
+        state,
+        k_pages=k_pages,
+        v_pages=v_pages,
+        context_lens=state.context_lens + n_emitted,
+        last_tokens=jnp.where(active, last, state.last_tokens),
+        rng=rng,
+    )
+    return new_state, emitted, n_emitted, (step_logits if return_logits else None)
 
 
 class InferenceEngine:
@@ -541,6 +637,17 @@ class InferenceEngine:
                 config=self.config, page_size=self.page_size,
                 attn_backend=self.attn_backend, return_logits=return_logits,
             )
+        if cfg.spec_tokens > 0:
+            # both verify-step variants (the scheduler's spec decode path)
+            zero_drafts = jnp.zeros((B, cfg.spec_tokens), jnp.int32)
+            zero_n = jnp.zeros((B,), jnp.int32)
+            for return_logits in (False, True):
+                self.state, _, _, _ = verify_step(
+                    self.params, self.state, inactive, zero_drafts, zero_n,
+                    temp, top_p, top_k,
+                    config=self.config, page_size=self.page_size,
+                    attn_backend=self.attn_backend, return_logits=return_logits,
+                )
         self.state, _ = commit_first_token(
             self.state, jnp.int32(0),
             jnp.zeros((self.config.vocab_size,), jnp.float32),
@@ -578,3 +685,15 @@ class InferenceEngine:
             attn_backend=self.attn_backend, return_logits=return_logits,
         )
         return (next_tokens, logits) if return_logits else next_tokens
+
+    def decode_spec(self, active, drafts, n_drafts, temperature, top_p, top_k,
+                    return_logits: bool = False):
+        """Speculative verify step (see verify_step). ``drafts`` [B, Kd]
+        keys the compiled shape — callers pad to a fixed Kd."""
+        self.state, emitted, n_emitted, logits = verify_step(
+            self.params, self.state, active, drafts, n_drafts,
+            temperature, top_p, top_k,
+            config=self.config, page_size=self.page_size,
+            attn_backend=self.attn_backend, return_logits=return_logits,
+        )
+        return (emitted, n_emitted, logits) if return_logits else (emitted, n_emitted)

@@ -71,6 +71,13 @@ class SequenceHandle:
     slot: int = -1
     prefill_pos: int = 0  # prompt tokens already prefilled
     generated: int = 0
+    # prompt + delivered tokens — the prompt-lookup draft source when
+    # speculative decoding is on (engine/spec.py); maintained by _deliver
+    history: list[int] = field(default_factory=list)
+    # incremental n-gram index over ``history`` (engine/spec.py NgramIndex),
+    # created lazily by the spec decode path and kept in sync by _deliver —
+    # proposing must be O(1) on the event loop, not a history rescan
+    ngram_index: object | None = None
     submitted_at: float = field(default_factory=time.perf_counter)
     first_token_at: float | None = None
     finished: bool = False
@@ -79,6 +86,8 @@ class SequenceHandle:
     def __post_init__(self) -> None:
         if self.span is None:
             self.span = RequestSpan(self.seq_id)
+        if not self.history:
+            self.history = list(self.prompt_ids)
 
     def _emit_first_token_metrics(self) -> None:
         if self.first_token_at is None:
@@ -116,6 +125,11 @@ class ContinuousBatchingScheduler:
         self._task: asyncio.Task | None = None
         self._running = False
         self._rng = np.random.default_rng(0)  # host-side constrained sampling
+        # speculative decoding (engine/spec.py): > 0 switches the decode
+        # path to depth-1 verify steps with Kd host-proposed drafts —
+        # drafting needs the previous token on the HOST, which depth-2
+        # pipelining by construction has not fetched yet
+        self.spec_k = cfg.spec_tokens
 
     # --- public API -----------------------------------------------------
     async def start(self) -> None:
@@ -213,6 +227,12 @@ class ContinuousBatchingScheduler:
             self.decoding.pop(handle.slot, None)
             if handle in self.prefilling:
                 self.prefilling.remove(handle)
+            # restore non-truncating defaults: the sampler's exact full-vocab
+            # fast path keys on ALL slots' params, so a freed slot must not
+            # keep a dead request's top_p/top_k (sampler.py sample())
+            self._temperature[handle.slot] = 0.0
+            self._top_p[handle.slot] = 1.0
+            self._top_k[handle.slot] = 0
             self.free_slots.append(handle.slot)
             handle.slot = -1
 
@@ -318,14 +338,8 @@ class ContinuousBatchingScheduler:
             if handle.finished:  # cancelled while fetching
                 continue
             try:
-                s = handle.sampling
                 if handle.constraint is not None:
-                    token_id = handle.constraint.pick(
-                        row_host, s.temperature, self._rng,
-                        remaining=s.max_new_tokens - handle.generated,
-                        top_p=s.top_p, top_k=s.top_k,
-                    )
-                    eng.set_last_token(handle.slot, token_id)
+                    token_id = self._constrained_pick(handle, row_host)
                 self.prefilling.remove(handle)
                 self.decoding[handle.slot] = handle
                 self._deliver(handle, int(token_id))
@@ -337,6 +351,9 @@ class ContinuousBatchingScheduler:
     def _deliver(self, handle: SequenceHandle, token_id: int) -> None:
         handle._emit_first_token_metrics()
         handle.generated += 1
+        handle.history.append(token_id)
+        if handle.ngram_index is not None:
+            handle.ngram_index.push(token_id)
         METRICS.inc("finchat_tokens_generated_total")
         if token_id == self.eos_id:
             self._evict(handle, "eos")
@@ -388,6 +405,94 @@ class ContinuousBatchingScheduler:
             constrained_slots=constrained_slots,
         )
 
+    def _constrained_pick(self, handle: SequenceHandle, row_logits) -> int:
+        """Host-side grammar pick for one constrained slot: choose the
+        token, write it back as the slot's next decode input, and return
+        it for delivery. The ONE place the pick's sampling arguments are
+        threaded (called from prefill completion, pipelined consume, and
+        the spec path)."""
+        s = handle.sampling
+        token = handle.constraint.pick(
+            row_logits, s.temperature, self._rng,
+            remaining=s.max_new_tokens - handle.generated,
+            top_p=s.top_p, top_k=s.top_k,
+        )
+        self.engine.set_last_token(handle.slot, token)
+        return token
+
+    async def _run_spec_step(self) -> None:
+        """One speculative verify step: propose drafts from each greedy
+        slot's n-gram index, score them all in one forward, deliver the
+        accepted prefix + bonus token per slot. Depth-1 by necessity (the
+        drafts extend the LAST delivered token); acceptance makes up for
+        the lost overlap by committing up to Kd+1 tokens per weights-read.
+        """
+        from finchat_tpu.engine.spec import NgramIndex
+
+        inject("scheduler.decode")
+        eng = self.engine
+        B = eng.engine_cfg.max_seqs
+        Kd = self.spec_k
+        active = np.zeros((B,), bool)
+        drafts = np.zeros((B, Kd), np.int32)
+        n_drafts = np.zeros((B,), np.int32)
+        members = []
+        for slot, handle in self.decoding.items():
+            active[slot] = True
+            members.append((slot, handle))
+            remaining = handle.sampling.max_new_tokens - handle.generated
+            if (
+                handle.constraint is None
+                and handle.sampling.temperature <= 0.0
+                and remaining >= 2
+            ):
+                if handle.ngram_index is None:  # one-time build; _deliver
+                    handle.ngram_index = NgramIndex(handle.history)  # keeps it in sync
+                prop = handle.ngram_index.propose(min(Kd, remaining - 1))
+                drafts[slot, : len(prop)] = prop
+                n_drafts[slot] = len(prop)
+        constrained_slots = sorted(
+            slot for slot, h in members if h.constraint is not None
+        )
+        need_logits = bool(constrained_slots)
+        result = eng.decode_spec(
+            jnp.asarray(active), jnp.asarray(drafts), jnp.asarray(n_drafts),
+            jnp.asarray(self._temperature),
+            jnp.asarray(self._top_p),
+            jnp.asarray(self._top_k),
+            return_logits=need_logits,
+        )
+        emitted, n_emitted, logits = result if need_logits else (*result, None)
+        if logits is not None:
+            logits = logits[jnp.asarray(constrained_slots, jnp.int32)]
+
+        emitted_host, n_emitted_host, logits_host = await asyncio.to_thread(
+            lambda: (
+                np.asarray(emitted),
+                np.asarray(n_emitted),
+                np.asarray(logits) if logits is not None else None,
+            )
+        )
+        accepted_total = 0
+        for slot, handle in members:
+            if handle.finished or handle.slot != slot:
+                continue  # evicted/cancelled since dispatch
+            if handle.constraint is not None and logits_host is not None:
+                token = self._constrained_pick(
+                    handle, logits_host[constrained_slots.index(slot)]
+                )
+                self._deliver(handle, token)
+                continue
+            n = int(n_emitted_host[slot])
+            accepted_total += max(0, n - 1)
+            for token in emitted_host[slot, :n]:
+                self._deliver(handle, int(token))
+                if handle.finished:  # EOS / length inside the prefix
+                    break
+        if accepted_total:
+            METRICS.inc("finchat_spec_tokens_accepted_total", accepted_total)
+        METRICS.set_gauge("finchat_batch_occupancy", len(self.decoding))
+
     async def _consume_step(self, step: _InFlightStep) -> None:
         """Fetch a dispatched step's tokens (in a worker thread, so the event
         loop keeps serving) and deliver them to the sequences that were in
@@ -403,13 +508,9 @@ class ContinuousBatchingScheduler:
             if handle.finished or handle.slot != slot:
                 continue  # evicted/cancelled since dispatch; token discarded
             if handle.constraint is not None and logits_host is not None:
-                token = handle.constraint.pick(
-                    logits_host[step.constrained_slots.index(slot)],
-                    handle.sampling.temperature, self._rng,
-                    remaining=handle.sampling.max_new_tokens - handle.generated,
-                    top_p=handle.sampling.top_p, top_k=handle.sampling.top_k,
+                token = self._constrained_pick(
+                    handle, logits_host[step.constrained_slots.index(slot)]
                 )
-                eng.set_last_token(slot, token)
                 self._deliver(handle, token)
             else:
                 self._deliver(handle, int(tokens_host[slot]))
@@ -446,7 +547,17 @@ class ContinuousBatchingScheduler:
                     for handle in list(self.prefilling):
                         self._evict(handle, "error", error=str(e))
 
-            if self.decoding:
+            if self.decoding and self.spec_k > 0:
+                try:
+                    # speculative mode is depth-1 (no inflight step exists in
+                    # this mode): constrained picks land before the next
+                    # dispatch, so no slot ever sits a step out
+                    await self._run_spec_step()
+                except Exception as e:
+                    logger.error("spec decode step error: %s", e)
+                    for handle in list(self.decoding.values()):
+                        self._evict(handle, "error", error=str(e))
+            elif self.decoding:
                 try:
                     # a grammar-constrained slot's next input comes from a
                     # host-side pick that lands when its step is CONSUMED —

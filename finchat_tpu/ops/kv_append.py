@@ -63,9 +63,12 @@ def _append_kernel(
     pos = pos_ref[b]
     off = pos % page_size
     layer = layer_ref[0]
-    phys = jnp.where(
-        n_valid_ref[b] > 0, page_table_ref[b, pos // page_size], TRASH_PAGE
-    )
+    valid = n_valid_ref[b] > 0
+    # the table read happens BEFORE the select, so an invalid lane's pos
+    # (e.g. a trash-redirected verify-step position at the slot's length
+    # limit) must not index past the table row — read column 0 instead
+    logical = jnp.where(valid, pos // page_size, 0)
+    phys = jnp.where(valid, page_table_ref[b, logical], TRASH_PAGE)
     hd = k_scr.shape[-1]
 
     kin = pltpu.make_async_copy(k_any.at[layer, phys], k_scr, sems.at[0])

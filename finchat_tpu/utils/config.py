@@ -174,6 +174,10 @@ class EngineConfig:
     # the mesh has a seq axis > 1 (SURVEY §5.7c); shorter ones use batched
     # chunked prefill
     ring_prefill_min_tokens: int = 4096
+    # speculative decoding: draft tokens per verify step, proposed by
+    # prompt-lookup (engine/spec.py); 0 = off. Greedy-exact — RAG answers
+    # quote retrieved rows, so drafts hit often on the product workload.
+    spec_tokens: int = 0
 
 
 @dataclass
@@ -264,6 +268,7 @@ def load_config(
     cfg.engine.ring_prefill_min_tokens = _env_int(
         "FINCHAT_RING_PREFILL_MIN", cfg.engine.ring_prefill_min_tokens
     )
+    cfg.engine.spec_tokens = _env_int("FINCHAT_SPEC_TOKENS", cfg.engine.spec_tokens)
     cfg.serve.port = _env_int("FINCHAT_PORT", cfg.serve.port)
 
     # --- optional JSON config file ---

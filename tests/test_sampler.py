@@ -41,6 +41,33 @@ def test_mixed_batch_greedy_and_sampled():
     assert 0 <= int(out[1]) < 3
 
 
+def test_full_categorical_fast_path_is_not_truncated():
+    """With no truncating slot (top_k=0, top_p=1) sampling is an exact
+    full-vocab categorical: tokens OUTSIDE the candidate set must be
+    reachable (candidates=2 here, uniform logits over 4 tokens)."""
+    logits = _logits([[1.0, 1.0, 1.0, 1.0]])
+    seen = set()
+    for seed in range(80):
+        out = sample(logits, jax.random.key(seed), jnp.ones(1), jnp.ones(1),
+                     jnp.zeros(1, jnp.int32), candidates=2)
+        seen.add(int(out[0]))
+    assert seen == {0, 1, 2, 3}
+
+
+def test_truncating_slot_forces_candidate_path():
+    """One truncating slot in the batch routes the WHOLE batch through the
+    candidate-set path: with candidates=2, the uniform slot can then only
+    ever draw from its top-2 candidates."""
+    logits = _logits([[10.0, 9.0, -50.0, -50.0], [1.0, 1.0, 1.0, 1.0]])
+    for seed in range(40):
+        out = sample(
+            logits, jax.random.key(seed), jnp.ones(2) * 2.0, jnp.ones(2),
+            jnp.asarray([1, 0], jnp.int32), candidates=2,
+        )
+        assert int(out[0]) == 0  # top_k=1 keeps only the argmax
+        assert int(out[1]) in (0, 1)  # truncated to the candidate set
+
+
 def test_sampled_distribution_roughly_matches():
     logits = _logits([[2.0, 1.0, 0.0]])
     counts = [0, 0, 0]

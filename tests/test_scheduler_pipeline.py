@@ -75,6 +75,37 @@ def test_exact_token_counts_under_pipelining():
         assert count == budget, (budgets, counts)
 
 
+def test_release_restores_non_truncating_slot_defaults():
+    """A freed slot must not keep a dead request's top_p/top_k: the
+    sampler's exact full-vocab fast path keys on ALL slots' params
+    (sampler.py), so one finished truncating request would otherwise
+    silently degrade every later batch to candidate-set truncation."""
+
+    async def run():
+        tok, scheduler, _ = _make_stack()
+        await scheduler.start()
+        try:
+            handle = await scheduler.submit(
+                "trunc", tok.encode("hello", add_bos=True),
+                SamplingParams(temperature=0.9, top_p=0.5, top_k=4, max_new_tokens=3),
+            )
+            while True:
+                event = await asyncio.wait_for(handle.events.get(), timeout=60)
+                if event["type"] == "done":
+                    break
+            slot_params = (
+                float(scheduler._temperature.max()),
+                float(scheduler._top_p.min()),
+                int(scheduler._top_k.max()),
+            )
+            return slot_params
+        finally:
+            await scheduler.stop()
+
+    temperature, top_p, top_k = asyncio.run(run())
+    assert temperature == 0.0 and top_p == 1.0 and top_k == 0
+
+
 def test_cancel_while_step_in_flight_is_safe():
     """Cancelling mid-decode frees the slot/pages while a speculative step
     referencing the old slot is still in flight; the survivor completes and

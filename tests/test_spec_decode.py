@@ -1,0 +1,298 @@
+"""Speculative decoding: verify-step exactness + prompt-lookup proposer.
+
+The contract (engine/engine.py verify_step): a greedy slot's emitted
+stream is token-for-token IDENTICAL to plain decode_step — speculation
+changes how many tokens commit per step, never which tokens.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from finchat_tpu.engine.engine import InferenceEngine, commit_first_token
+from finchat_tpu.engine.kv_cache import PageAllocator, pages_needed
+from finchat_tpu.engine.spec import propose_ngram_drafts
+from finchat_tpu.models.llama import PRESETS, init_params
+from finchat_tpu.utils.config import EngineConfig
+
+CONFIG = PRESETS["tiny"]
+ENGINE_CFG = EngineConfig(max_seqs=4, page_size=8, num_pages=64, max_seq_len=128, prefill_chunk=8)
+KD = 3  # draft tokens per verify step in these tests
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CONFIG, jax.random.key(0))
+
+
+def _arm_slot(eng, alloc, slot, prompt, budget, seq_id):
+    pages = alloc.allocate(seq_id, pages_needed(len(prompt) + budget, eng.page_size))
+    eng.set_page_table_row(slot, pages)
+    logits = eng.prefill(slot, prompt)
+    eng.state, tok = commit_first_token(
+        eng.state, jnp.int32(slot), logits, jnp.float32(0.0), jnp.float32(1.0), jnp.int32(0)
+    )
+    return int(tok)
+
+
+def _greedy_reference(params, prompt, n_new):
+    """Plain decode_step greedy tokens (the oracle for exactness)."""
+    eng = InferenceEngine(CONFIG, params, ENGINE_CFG)
+    alloc = PageAllocator(ENGINE_CFG.num_pages)
+    out = [_arm_slot(eng, alloc, 0, prompt, n_new, "ref")]
+    B = ENGINE_CFG.max_seqs
+    active = jnp.zeros((B,), bool).at[0].set(True)
+    z, o, zk = jnp.zeros((B,)), jnp.ones((B,)), jnp.zeros((B,), jnp.int32)
+    for _ in range(n_new - 1):
+        out.append(int(eng.decode(active, z, o, zk)[0]))
+    return out
+
+
+def _spec_greedy(params, prompt, n_new, drafts_for):
+    """Greedy decode via verify steps; ``drafts_for(tokens_so_far)`` returns
+    the next step's draft list (possibly empty)."""
+    eng = InferenceEngine(CONFIG, params, ENGINE_CFG)
+    alloc = PageAllocator(ENGINE_CFG.num_pages)
+    out = [_arm_slot(eng, alloc, 0, prompt, n_new, "spec")]
+    B = ENGINE_CFG.max_seqs
+    active = jnp.zeros((B,), bool).at[0].set(True)
+    z, o, zk = jnp.zeros((B,)), jnp.ones((B,)), jnp.zeros((B,), jnp.int32)
+    steps = 0
+    while len(out) < n_new:
+        proposal = list(drafts_for(list(out)))[: min(KD, n_new - len(out) - 1)]
+        drafts = np.zeros((B, KD), np.int32)
+        n_drafts = np.zeros((B,), np.int32)
+        drafts[0, : len(proposal)] = proposal
+        n_drafts[0] = len(proposal)
+        emitted, n_emitted = eng.decode_spec(
+            active, jnp.asarray(drafts), jnp.asarray(n_drafts), z, o, zk
+        )
+        n = int(n_emitted[0])
+        assert 1 <= n <= len(proposal) + 1
+        out.extend(int(t) for t in np.asarray(emitted[0, :n]))
+        steps += 1
+    return out, steps
+
+
+def test_correct_drafts_all_accepted(params):
+    """Drafting the true greedy continuation commits Kd+1 tokens per step."""
+    prompt = [5, 9, 2, 100, 17, 3]
+    n_new = 9
+    want = _greedy_reference(params, prompt, n_new)
+    got, steps = _spec_greedy(
+        params, prompt, n_new,
+        # oracle drafts: the actual upcoming greedy tokens
+        lambda so_far: want[len(so_far): len(so_far) + KD],
+    )
+    assert got == want
+    # 1 commit token + ceil(8 remaining / (KD+1)) fully-accepted steps
+    assert steps == -(-(n_new - 1) // (KD + 1))
+
+
+def test_wrong_drafts_rejected_exactly(params):
+    """Garbage drafts must not corrupt the stream: every step falls back to
+    the single model token and the KV left by rejected drafts is ignored
+    and overwritten."""
+    prompt = [5, 9, 2, 100, 17, 3]
+    n_new = 7
+    want = _greedy_reference(params, prompt, n_new)
+    wrong = [(want[i] + 1) % CONFIG.vocab_size for i in range(len(want))]
+    got, steps = _spec_greedy(
+        params, prompt, n_new,
+        lambda so_far: wrong[len(so_far): len(so_far) + KD],
+    )
+    assert got == want
+    assert steps == n_new - 1  # nothing accepted -> one token per step
+
+
+def test_partial_acceptance(params):
+    """A draft list that is right then wrong commits exactly the matching
+    prefix plus the correction."""
+    prompt = [5, 9, 2, 100, 17, 3]
+    n_new = 8
+    want = _greedy_reference(params, prompt, n_new)
+
+    def half_right(so_far):
+        i = len(so_far)
+        good = want[i: i + KD]
+        if len(good) < 2:
+            return good
+        return [good[0], (good[1] + 1) % CONFIG.vocab_size, good[0]]
+
+    got, _ = _spec_greedy(params, prompt, n_new, half_right)
+    assert got == want
+
+
+def test_no_drafts_matches_plain_decode(params):
+    """n_drafts == 0 everywhere reduces verify_step to decode_step."""
+    prompt = [7, 7, 3, 250]
+    n_new = 6
+    want = _greedy_reference(params, prompt, n_new)
+    got, steps = _spec_greedy(params, prompt, n_new, lambda so_far: [])
+    assert got == want and steps == n_new - 1
+
+
+def test_mixed_batch_isolation(params):
+    """A drafting slot and a draft-free slot in the same verify step each
+    produce their own reference stream."""
+    eng = InferenceEngine(CONFIG, params, ENGINE_CFG)
+    alloc = PageAllocator(ENGINE_CFG.num_pages)
+    prompt_a, prompt_b = [5, 9, 2, 100, 17, 3], [11, 4, 200]
+    n_new = 6
+    want_a = _greedy_reference(params, prompt_a, n_new)
+    want_b = _greedy_reference(params, prompt_b, n_new)
+    out = {0: [_arm_slot(eng, alloc, 0, prompt_a, n_new, "a")],
+           2: [_arm_slot(eng, alloc, 2, prompt_b, n_new, "b")]}
+    B = ENGINE_CFG.max_seqs
+    active = jnp.zeros((B,), bool).at[0].set(True).at[2].set(True)
+    z, o, zk = jnp.zeros((B,)), jnp.ones((B,)), jnp.zeros((B,), jnp.int32)
+    while len(out[0]) < n_new or len(out[2]) < n_new:
+        drafts = np.zeros((B, KD), np.int32)
+        n_drafts = np.zeros((B,), np.int32)
+        prop = want_a[len(out[0]): len(out[0]) + KD]  # oracle drafts, slot 0 only
+        prop = prop[: max(0, n_new - len(out[0]) - 1)]
+        drafts[0, : len(prop)] = prop
+        n_drafts[0] = len(prop)
+        emitted, n_emitted = eng.decode_spec(
+            active, jnp.asarray(drafts), jnp.asarray(n_drafts), z, o, zk
+        )
+        for slot in (0, 2):
+            n = int(n_emitted[slot])
+            take = min(n, n_new - len(out[slot]))
+            out[slot].extend(int(t) for t in np.asarray(emitted[slot, :take]))
+    assert out[0] == want_a
+    assert out[2] == want_b
+
+
+def _run_scheduler_stream(params, spec_tokens, prompt_text, n_new, temperature=0.0):
+    """Submit one request through the full scheduler and collect its token
+    stream (spec_tokens=0 -> pipelined decode path, >0 -> verify steps)."""
+    import asyncio
+    import dataclasses as dc
+
+    from finchat_tpu.engine.sampler import SamplingParams
+    from finchat_tpu.engine.scheduler import ContinuousBatchingScheduler
+    from finchat_tpu.models.tokenizer import ByteTokenizer
+
+    async def run():
+        tok = ByteTokenizer()
+        cfg = dc.replace(ENGINE_CFG, spec_tokens=spec_tokens)
+        eng = InferenceEngine(CONFIG, params, cfg)
+        scheduler = ContinuousBatchingScheduler(eng, eos_id=tok.eos_id)
+        await scheduler.start()
+        try:
+            handle = await scheduler.submit(
+                "s", tok.encode(prompt_text, add_bos=True),
+                SamplingParams(temperature=temperature, max_new_tokens=n_new),
+            )
+            tokens = []
+            while True:
+                event = await asyncio.wait_for(handle.events.get(), timeout=120)
+                if event["type"] == "token":
+                    tokens.append(event["token_id"])
+                elif event["type"] == "done":
+                    return tokens
+                else:
+                    raise AssertionError(event)
+        finally:
+            await scheduler.stop()
+
+    return asyncio.run(run())
+
+
+def test_scheduler_spec_stream_matches_plain_greedy(params):
+    """End-to-end through the continuous-batching scheduler: the greedy
+    token stream with speculative decoding on (prompt-lookup drafts) must
+    equal the non-speculative stream exactly."""
+    plain = _run_scheduler_stream(params, 0, "abcabcabc", 16)
+    spec = _run_scheduler_stream(params, 3, "abcabcabc", 16)
+    assert spec == plain
+    assert len(plain) == 16
+
+
+def test_scheduler_spec_sampled_slot_rides_draft_free(params):
+    """temperature > 0 slots never draft but must still stream the full
+    budget through the spec path."""
+    tokens = _run_scheduler_stream(params, 3, "hello", 8, temperature=0.9)
+    assert len(tokens) == 8
+
+
+def test_scheduler_spec_with_constrained_slot(params):
+    """Grammar-constrained sequences ride verify steps draft-free: the
+    host-side pick lands before the next dispatch (spec mode is depth-1),
+    and bystander greedy slots keep speculating. Both must complete."""
+    import asyncio
+    import dataclasses as dc
+
+    from finchat_tpu.agent.constrained import GrammarVocab, TokenConstraint
+    from finchat_tpu.engine.sampler import SamplingParams
+    from finchat_tpu.engine.scheduler import ContinuousBatchingScheduler
+    from finchat_tpu.models.tokenizer import ByteTokenizer
+
+    async def run():
+        tok = ByteTokenizer()
+        cfg = dc.replace(ENGINE_CFG, spec_tokens=3)
+        eng = InferenceEngine(CONFIG, params, cfg)
+        scheduler = ContinuousBatchingScheduler(eng, eos_id=tok.eos_id)
+        vocab = GrammarVocab.for_tokenizer(tok)
+        await scheduler.start()
+        try:
+            bystander = await scheduler.submit(
+                "bystander", tok.encode("abcabc", add_bos=True),
+                SamplingParams(temperature=0.0, max_new_tokens=12),
+            )
+            constrained = await scheduler.submit(
+                "tool", tok.encode("decide", add_bos=True),
+                SamplingParams(temperature=0.7, max_new_tokens=24),
+                constraint=TokenConstraint(vocab),
+            )
+            counts = {"bystander": 0, "tool": 0}
+            for name, handle in (("bystander", bystander), ("tool", constrained)):
+                while True:
+                    event = await asyncio.wait_for(handle.events.get(), timeout=120)
+                    if event["type"] == "token":
+                        counts[name] += 1
+                    elif event["type"] == "done":
+                        break
+                    else:
+                        raise AssertionError(event)
+            return counts
+        finally:
+            await scheduler.stop()
+
+    counts = asyncio.run(run())
+    assert counts["bystander"] == 12
+    assert counts["tool"] >= 1  # grammar emitted something before closing
+
+
+def test_ngram_proposer():
+    # repetition: suffix [3, 4] occurred earlier, followed by 5, 6
+    assert propose_ngram_drafts([1, 2, 3, 4, 5, 6, 9, 3, 4], 2) == [5, 6]
+    # longest n-gram wins over a shorter, more recent match
+    hist = [1, 2, 3, 7, 7, 2, 3, 8, 1, 2, 3]
+    assert propose_ngram_drafts(hist, 1, ngram=3) == [7]
+    # no recurrence -> no drafts
+    assert propose_ngram_drafts([1, 2, 3, 4, 5], 4) == []
+    # k caps the draft length
+    assert propose_ngram_drafts([1, 2, 3, 4, 1, 2], 10) == [3, 4, 1, 2]
+    # degenerate inputs
+    assert propose_ngram_drafts([], 4) == []
+    assert propose_ngram_drafts([1, 2], 0) == []
+
+
+def test_ngram_index_incremental_matches_oneshot():
+    """Pushing token-by-token must propose exactly what a fresh index over
+    the full history proposes (the scheduler keeps a live index; the
+    one-shot wrapper is the reference)."""
+    import random
+
+    from finchat_tpu.engine.spec import NgramIndex
+
+    rng = random.Random(7)
+    history = [rng.randrange(6) for _ in range(400)]  # small alphabet: many repeats
+    live = NgramIndex()
+    for i, tok in enumerate(history):
+        live.push(tok)
+        if i % 17 == 0:
+            assert live.propose(4) == propose_ngram_drafts(history[: i + 1], 4)
