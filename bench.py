@@ -147,7 +147,14 @@ def measure(preset: str, batch: int, prompt_len: int, steps: int, warmup: int,
         prefill_chunk=max(prompt_len, 128),
     )
 
-    params = init_params(config, jax.random.key(0))
+    if quant:
+        # leaf-at-a-time quantized init: the full bf16 tree for llama3-8b
+        # (16 GB) would not fit one v5e chip's HBM alongside anything else
+        from finchat_tpu.models.quant import init_quantized_llama_params
+
+        params = init_quantized_llama_params(config, jax.random.key(0))
+    else:
+        params = init_params(config, jax.random.key(0))
     engine = InferenceEngine(config, params, engine_cfg, attn_backend=attn,
                              quant=quant)
 

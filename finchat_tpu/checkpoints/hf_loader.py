@@ -44,11 +44,18 @@ def load_llama_params(
     config: LlamaConfig,
     *,
     shardings: dict[str, Any] | None = None,
+    quant: str = "",
 ) -> dict[str, Any]:
     """Load HF Llama weights into the stacked pytree layout.
 
     ``shardings``: optional map from our param path (e.g. ``layers/attn_q``)
     to a ``jax.sharding.Sharding`` for direct sharded placement.
+
+    ``quant="int8"``: quantize each matmul weight AT LOAD, one tensor at a
+    time (models/quant.py) — the device never holds more than one bf16
+    leaf alongside the int8 tree, so llama3-8b (16 GB bf16) loads onto one
+    16 GB v5e chip. Same numerics as quantizing after a full-precision
+    load.
     """
     path = Path(checkpoint_dir)
     tensors: dict[str, np.ndarray] = {}
@@ -82,11 +89,23 @@ def load_llama_params(
             )
 
     dtype = config.dtype
+    if quant and quant != "int8":
+        raise ValueError(f"unknown quant mode {quant!r} (supported: 'int8')")
 
-    def put(path_key: str, array: np.ndarray) -> jax.Array:
+    def put(path_key: str, array: np.ndarray) -> Any:
         arr = jnp.asarray(array, dtype=dtype)
         if shardings and path_key in shardings:
-            return jax.device_put(arr, shardings[path_key])
+            arr = jax.device_put(arr, shardings[path_key])
+        if quant:
+            from finchat_tpu.models.quant import QUANT_LAYER_LEAVES, quantize
+
+            leaf = path_key.rsplit("/", 1)[-1]
+            if leaf in QUANT_LAYER_LEAVES or leaf == "lm_head":
+                qt = quantize(arr)
+                # free the bf16 copy before the next tensor materializes
+                jax.block_until_ready(qt.q)
+                del arr
+                return qt
         return arr
 
     def stack(fmt: str, transpose: bool = True) -> np.ndarray:

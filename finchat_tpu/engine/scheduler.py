@@ -202,9 +202,15 @@ class ContinuousBatchingScheduler:
             admitted[slot] = pages
             handle.slot = slot
             handle.span.mark("admitted")
-            self._temperature[slot] = handle.sampling.temperature
-            self._top_p[slot] = handle.sampling.top_p
-            self._top_k[slot] = handle.sampling.top_k
+            if handle.constraint is None:
+                self._temperature[slot] = handle.sampling.temperature
+                self._top_p[slot] = handle.sampling.top_p
+                self._top_k[slot] = handle.sampling.top_k
+            # constrained slots keep the non-truncating defaults: their
+            # device-sampled token is always discarded for the host-side
+            # grammar pick (_constrained_pick), and a truncating top_p/top_k
+            # here would knock the WHOLE batch off the sampler's exact
+            # full-vocab fast path (sampler.py sample())
             self.prefilling.append(handle)
             logger.debug("admitted %s into slot %d (%d pages)", handle.seq_id, slot, need)
         if admitted:
@@ -405,6 +411,17 @@ class ContinuousBatchingScheduler:
             constrained_slots=constrained_slots,
         )
 
+    def _spec_candidates(self) -> bool:
+        """True when at least one decoding slot can benefit from a verify
+        step (greedy, unconstrained, ≥2 tokens to go) — otherwise the
+        pipelined depth-2 decode path is strictly better."""
+        return any(
+            h.constraint is None
+            and h.sampling.temperature <= 0.0
+            and h.sampling.max_new_tokens - h.generated >= 2
+            for h in self.decoding.values()
+        )
+
     def _constrained_pick(self, handle: SequenceHandle, row_logits) -> int:
         """Host-side grammar pick for one constrained slot: choose the
         token, write it back as the slot's next decode input, and return
@@ -451,6 +468,14 @@ class ContinuousBatchingScheduler:
                 prop = handle.ngram_index.propose(min(Kd, remaining - 1))
                 drafts[slot, : len(prop)] = prop
                 n_drafts[slot] = len(prop)
+        if not n_drafts.any():
+            # every candidate missed its n-gram lookup this step: a
+            # Kd+1-wide verify forward would cost K× the query compute for
+            # an unconditional n_emitted == 1 — run the plain (cheaper,
+            # already-warmed) decode step instead
+            await self._consume_step(self._dispatch_decode())
+            return
+
         constrained_slots = sorted(
             slot for slot, h in members if h.constraint is not None
         )
@@ -547,14 +572,19 @@ class ContinuousBatchingScheduler:
                     for handle in list(self.prefilling):
                         self._evict(handle, "error", error=str(e))
 
-            if self.decoding and self.spec_k > 0:
+            if self.decoding and self.spec_k > 0 and self._spec_candidates():
                 try:
-                    # speculative mode is depth-1 (no inflight step exists in
-                    # this mode): constrained picks land before the next
-                    # dispatch, so no slot ever sits a step out
+                    # speculative decode is depth-1: constrained picks land
+                    # before the next dispatch, so no slot ever sits a step
+                    # out. Drain any pipelined step left over from the
+                    # depth-2 path before switching modes.
+                    if inflight is not None:
+                        await self._consume_step(inflight)
+                        inflight = None
                     await self._run_spec_step()
                 except Exception as e:
                     logger.error("spec decode step error: %s", e)
+                    inflight = None
                     for handle in list(self.decoding.values()):
                         self._evict(handle, "error", error=str(e))
             elif self.decoding:

@@ -87,7 +87,9 @@ PRESETS: dict[str, LlamaConfig] = {
 }
 
 
-def init_params(config: LlamaConfig, key: Array) -> dict[str, Any]:
+def init_params(
+    config: LlamaConfig, key: Array, leaf_transform: Any = None
+) -> dict[str, Any]:
     """Random-init params as a pytree with stacked layers.
 
     Layout (L = n_layers, leading axis of every ``layers`` leaf):
@@ -95,22 +97,28 @@ def init_params(config: LlamaConfig, key: Array) -> dict[str, Any]:
       layers/attn_{q,k,v,o}[L, ...], layers/mlp_{gate,up,down}[L, ...],
       layers/ln_attn[L, dim], layers/ln_mlp[L, dim]
       norm[dim], lm_head[dim, vocab] (absent when tie_embeddings)
+
+    ``leaf_transform(name, array)`` is applied to each MATMUL weight at
+    creation, before the next leaf materializes — so e.g. int8 quantization
+    (models/quant.py init_quantized_llama_params) never holds the full
+    bf16 tree, which for llama3-8b alone exceeds one v5e chip's 16 GB HBM.
     """
     c = config
     k_embed, k_layers, k_head = jax.random.split(key, 3)
+    tf = leaf_transform or (lambda name, x: x)
 
-    def rand_init(k: Array, shape: tuple[int, ...], fan_in: int) -> Array:
-        return (jax.random.normal(k, shape, jnp.float32) * fan_in ** -0.5).astype(c.dtype)
+    def rand_init(name: str, k: Array, shape: tuple[int, ...], fan_in: int) -> Array:
+        return tf(name, (jax.random.normal(k, shape, jnp.float32) * fan_in ** -0.5).astype(c.dtype))
 
     keys = jax.random.split(k_layers, 8)
     L, D, H, Hkv, hd, F = c.n_layers, c.dim, c.n_heads, c.n_kv_heads, c.head_dim, c.hidden_dim
     params: dict[str, Any] = {
-        "embed": rand_init(k_embed, (c.vocab_size, D), D),
+        "embed": rand_init("embed", k_embed, (c.vocab_size, D), D),
         "layers": {
-            "attn_q": rand_init(keys[0], (L, D, H * hd), D),
-            "attn_k": rand_init(keys[1], (L, D, Hkv * hd), D),
-            "attn_v": rand_init(keys[2], (L, D, Hkv * hd), D),
-            "attn_o": rand_init(keys[3], (L, H * hd, D), H * hd),
+            "attn_q": rand_init("attn_q", keys[0], (L, D, H * hd), D),
+            "attn_k": rand_init("attn_k", keys[1], (L, D, Hkv * hd), D),
+            "attn_v": rand_init("attn_v", keys[2], (L, D, Hkv * hd), D),
+            "attn_o": rand_init("attn_o", keys[3], (L, H * hd, D), H * hd),
             "ln_attn": jnp.ones((L, D), c.dtype),
             "ln_mlp": jnp.ones((L, D), c.dtype),
         },
@@ -122,21 +130,21 @@ def init_params(config: LlamaConfig, key: Array) -> dict[str, Any]:
             {
                 # router stays fp32: routing is precision-sensitive, tiny
                 "router": jax.random.normal(keys[7], (L, D, E), jnp.float32) * D ** -0.5,
-                "moe_gate": rand_init(keys[4], (L, E, D, F), D),
-                "moe_up": rand_init(keys[5], (L, E, D, F), D),
-                "moe_down": rand_init(keys[6], (L, E, F, D), F),
+                "moe_gate": rand_init("moe_gate", keys[4], (L, E, D, F), D),
+                "moe_up": rand_init("moe_up", keys[5], (L, E, D, F), D),
+                "moe_down": rand_init("moe_down", keys[6], (L, E, F, D), F),
             }
         )
     else:
         params["layers"].update(
             {
-                "mlp_gate": rand_init(keys[4], (L, D, F), D),
-                "mlp_up": rand_init(keys[5], (L, D, F), D),
-                "mlp_down": rand_init(keys[6], (L, F, D), F),
+                "mlp_gate": rand_init("mlp_gate", keys[4], (L, D, F), D),
+                "mlp_up": rand_init("mlp_up", keys[5], (L, D, F), D),
+                "mlp_down": rand_init("mlp_down", keys[6], (L, F, D), F),
             }
         )
     if not c.tie_embeddings:
-        params["lm_head"] = rand_init(k_head, (D, c.vocab_size), D)
+        params["lm_head"] = rand_init("lm_head", k_head, (D, c.vocab_size), D)
     return params
 
 

@@ -91,16 +91,38 @@ def dense(x: Array, w: Array | QTensor) -> Array:
     return x @ w
 
 
+def init_quantized_llama_params(config: Any, key: Any) -> dict[str, Any]:
+    """Random-init a param tree with matmul weights ALREADY int8 — each
+    leaf quantizes at creation (models/llama.py ``leaf_transform``), so the
+    full bf16 tree never coexists with the int8 one. This is what lets a
+    random-weight llama3-8b (16 GB bf16) materialize on one 16 GB v5e chip
+    for benching; checkpoint serving gets the same effect from the loader's
+    per-tensor path. Identical numerics to ``quantize_llama_params``
+    applied after ``init_params`` (asserted in tests/test_quant.py)."""
+
+    def leaf_transform(name: str, w: Any) -> Any:
+        if name in QUANT_LAYER_LEAVES or name == "lm_head":
+            return quantize(w)
+        return w
+
+    from finchat_tpu.models.llama import init_params
+
+    return init_params(config, key, leaf_transform=leaf_transform)
+
+
 def quantize_llama_params(params: dict[str, Any]) -> dict[str, Any]:
     """Quantize a Llama/Mixtral param tree's matmul weights in place of the
     bf16 leaves (models/llama.py layout). Embedding (a gather, not a
     matmul), norms, and the MoE router stay full precision; ``lm_head`` is
     quantized when present (tied-embedding models keep the dense path)."""
+    def q(leaf: Any) -> Any:
+        return leaf if isinstance(leaf, QTensor) else quantize(leaf)  # idempotent
+
     layers = {
-        name: quantize(leaf) if name in QUANT_LAYER_LEAVES else leaf
+        name: q(leaf) if name in QUANT_LAYER_LEAVES else leaf
         for name, leaf in params["layers"].items()
     }
     out = {**params, "layers": layers}
     if "lm_head" in params:
-        out["lm_head"] = quantize(params["lm_head"])
+        out["lm_head"] = q(params["lm_head"])
     return out

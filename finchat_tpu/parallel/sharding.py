@@ -154,10 +154,26 @@ def shard_params(params: dict[str, Any], shardings: dict[str, Any]) -> dict[str,
             return {k: prune(spec[k], v) for k, v in tree.items()}
         return spec
 
+    from finchat_tpu.models.quant import QTensor
+
+    def place(x, s):
+        if isinstance(x, QTensor):
+            # pre-quantized leaf (streaming int8 load): q takes the weight's
+            # spec; the per-output-column scale [..., N] drops the spec's
+            # contraction axis (-2)
+            spec = list(s.spec) + [None] * (x.q.ndim - len(s.spec))
+            scale_s = NamedSharding(s.mesh, P(*spec[:-2], spec[-1]))
+            return QTensor(
+                q=jax.device_put(x.q, _fit_sharding(s, x.q.shape, x.q.nbytes)),
+                scale=jax.device_put(
+                    x.scale, _fit_sharding(scale_s, x.scale.shape, x.scale.nbytes)
+                ),
+            )
+        return jax.device_put(x, _fit_sharding(s, x.shape, x.nbytes))
+
     pruned = prune(shardings, params)
     return jax.tree.map(
-        lambda x, s: jax.device_put(x, _fit_sharding(s, x.shape, x.nbytes)),
-        params, pruned,
+        place, params, pruned, is_leaf=lambda x: isinstance(x, QTensor)
     )
 
 

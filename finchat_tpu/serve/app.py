@@ -92,10 +92,18 @@ def build_generators(cfg: AppConfig) -> tuple[TextGenerator, TextGenerator, Cont
     if cfg.model.checkpoint_path:
         from finchat_tpu.checkpoints.hf_loader import load_llama_params
 
-        params = load_llama_params(cfg.model.checkpoint_path, config)
+        # quantize per-tensor AT LOAD so the full bf16 tree never has to
+        # fit in HBM (8B int8 on one 16 GB chip); the engine's own
+        # quantize pass is idempotent on the already-QTensor leaves
+        params = load_llama_params(cfg.model.checkpoint_path, config,
+                                   quant=cfg.model.quant)
     else:
         logger.warning("no checkpoint configured; using RANDOM weights (preset=%s)", cfg.model.preset)
-        params = init_params(config, jax.random.key(cfg.model.seed))
+        if cfg.model.quant:
+            from finchat_tpu.models.quant import init_quantized_llama_params as init_fn
+        else:
+            init_fn = init_params
+        params = init_fn(config, jax.random.key(cfg.model.seed))
     from finchat_tpu.parallel.mesh import MeshSpec, build_mesh
 
     spec = MeshSpec.from_config(cfg.mesh)

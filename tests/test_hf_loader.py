@@ -177,6 +177,25 @@ def test_tied_embedding_checkpoint(tmp_path):
     np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-3)
 
 
+def test_quantized_load_matches_load_then_quantize(checkpoint):
+    """Per-tensor int8 loading (what lets 8B checkpoints onto one chip)
+    must equal quantizing a full-precision load."""
+    import jax
+    import numpy as np
+
+    from finchat_tpu.models.quant import QTensor, quantize_llama_params
+
+    path, _, _ = checkpoint
+    streamed = load_llama_params(str(path), OUR_CFG, quant="int8")
+    full = quantize_llama_params(load_llama_params(str(path), OUR_CFG))
+    assert isinstance(streamed["layers"]["attn_q"], QTensor)
+    flat_s, tree_s = jax.tree_util.tree_flatten(streamed)
+    flat_f, tree_f = jax.tree_util.tree_flatten(full)
+    assert tree_s == tree_f
+    for a, b in zip(flat_s, flat_f):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_config_mismatch_raises(checkpoint):
     path, _, _ = checkpoint
     wrong = LlamaConfig(

@@ -141,6 +141,47 @@ def test_quantized_engine_matches_quantized_oracle():
     assert _engine_greedy(eng, prompt, n_new) == want
 
 
+@pytest.mark.parametrize("preset", ["tiny", "moe-tiny"])
+def test_streaming_init_matches_init_then_quantize(preset):
+    """init_quantized_llama_params (leaf-at-a-time, what lets 8B fit one
+    chip) must be numerically identical to quantizing a full init."""
+    from finchat_tpu.models.quant import init_quantized_llama_params
+
+    config = PRESETS[preset]
+    streamed = init_quantized_llama_params(config, jax.random.key(4))
+    full = quantize_llama_params(init_params(config, jax.random.key(4)))
+
+    flat_s, tree_s = jax.tree_util.tree_flatten(streamed)
+    flat_f, tree_f = jax.tree_util.tree_flatten(full)
+    assert tree_s == tree_f
+    for a, b in zip(flat_s, flat_f):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_prequantized_params_shard_and_decode():
+    """A pre-quantized tree (streaming load path) must shard over TP (the
+    QTensor-aware shard_params) and decode identically to engine-side
+    quantization of the same weights."""
+    from finchat_tpu.models.quant import init_quantized_llama_params
+    from finchat_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    config = LlamaConfig(
+        vocab_size=128, dim=64, n_layers=2, n_heads=8, n_kv_heads=8,
+        hidden_dim=128, max_seq_len=64,
+    )
+    ecfg = EngineConfig(max_seqs=2, page_size=8, num_pages=16, max_seq_len=64, prefill_chunk=8)
+    prompt, n_new = [5, 9, 2, 100, 17, 3], 6
+    mesh = build_mesh(MeshSpec(data=1, seq=1, expert=1, model=8))
+
+    pre = init_quantized_llama_params(config, jax.random.key(0))
+    got = _engine_greedy(
+        InferenceEngine(config, pre, ecfg, mesh=mesh, quant="int8"), prompt, n_new)
+    want = _engine_greedy(
+        InferenceEngine(config, init_params(config, jax.random.key(0)), ecfg,
+                        mesh=mesh, quant="int8"), prompt, n_new)
+    assert got == want
+
+
 def test_tp_quantized_engine_matches_unsharded():
     """Quantize-after-shard (engine/engine.py) must not change the tokens:
     TP=8 int8 greedy decode == single-device int8 greedy decode."""

@@ -36,9 +36,9 @@ def _arm_slot(eng, alloc, slot, prompt, budget, seq_id):
     return int(tok)
 
 
-def _greedy_reference(params, prompt, n_new):
+def _greedy_reference(params, prompt, n_new, attn=None):
     """Plain decode_step greedy tokens (the oracle for exactness)."""
-    eng = InferenceEngine(CONFIG, params, ENGINE_CFG)
+    eng = InferenceEngine(CONFIG, params, ENGINE_CFG, attn_backend=attn)
     alloc = PageAllocator(ENGINE_CFG.num_pages)
     out = [_arm_slot(eng, alloc, 0, prompt, n_new, "ref")]
     B = ENGINE_CFG.max_seqs
@@ -49,10 +49,10 @@ def _greedy_reference(params, prompt, n_new):
     return out
 
 
-def _spec_greedy(params, prompt, n_new, drafts_for):
+def _spec_greedy(params, prompt, n_new, drafts_for, attn=None):
     """Greedy decode via verify steps; ``drafts_for(tokens_so_far)`` returns
     the next step's draft list (possibly empty)."""
-    eng = InferenceEngine(CONFIG, params, ENGINE_CFG)
+    eng = InferenceEngine(CONFIG, params, ENGINE_CFG, attn_backend=attn)
     alloc = PageAllocator(ENGINE_CFG.num_pages)
     out = [_arm_slot(eng, alloc, 0, prompt, n_new, "spec")]
     B = ENGINE_CFG.max_seqs
@@ -121,6 +121,28 @@ def test_partial_acceptance(params):
         return [good[0], (good[1] + 1) % CONFIG.vocab_size, good[0]]
 
     got, _ = _spec_greedy(params, prompt, n_new, half_right)
+    assert got == want
+
+
+def test_kernel_path_partial_acceptance(params):
+    """The TPU production write path — verify_step's per-token IN-PLACE
+    kv-append loop (engine.py inplace_append, interpret-mode kernels) —
+    must reproduce the same greedy stream as the jnp scatter path the
+    other tests run ('ref' backend on CPU): positions, per-token validity,
+    and rejected-draft overwrites all go through ops/kv_append.py here."""
+    prompt = [5, 9, 2, 100, 17, 3]
+    n_new = 6
+    want = _greedy_reference(params, prompt, n_new, attn="pallas-interpret")
+    assert want == _greedy_reference(params, prompt, n_new)  # backends agree
+
+    def half_right(so_far):
+        i = len(so_far)
+        good = want[i: i + KD]
+        if len(good) < 2:
+            return good
+        return [good[0], (good[1] + 1) % CONFIG.vocab_size, good[0]]
+
+    got, _ = _spec_greedy(params, prompt, n_new, half_right, attn="pallas-interpret")
     assert got == want
 
 
