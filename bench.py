@@ -65,6 +65,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--quant", choices=("int8",), default=None,
                    help="serve int8 weight-only quantized params "
                         "(models/quant.py); default bf16")
+    p.add_argument("--spec-tokens", type=int, default=None,
+                   help="also measure the speculative verify step at this "
+                        "draft width (engine/spec.py): cost per step and "
+                        "the full-acceptance throughput envelope")
     p.add_argument("--tpu-timeout", type=float, default=180.0,
                    help="seconds allowed for TPU backend INIT before the "
                         "child is declared hung (measurement gets "
@@ -116,7 +120,8 @@ def run_worker(args: argparse.Namespace) -> int:
     faulthandler.dump_traceback_later(max(60.0, args.measure_budget - 10.0), exit=True)
 
     work = resolve_workload(args, "tpu" if platform == "tpu" else "cpu")
-    result = measure(attn=args.attn, quant=args.quant or "", **work)
+    result = measure(attn=args.attn, quant=args.quant or "",
+                     spec_tokens=args.spec_tokens or 0, **work)
     result["backend_init_s"] = round(init_s, 1)
     print(json.dumps(result), flush=True)
     return 0
@@ -124,7 +129,7 @@ def run_worker(args: argparse.Namespace) -> int:
 
 def measure(preset: str, batch: int, prompt_len: int, steps: int, warmup: int,
             page_size: int, max_seq_len: int, attn: str | None,
-            quant: str = "") -> dict:
+            quant: str = "", spec_tokens: int = 0) -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -257,6 +262,61 @@ def measure(preset: str, batch: int, prompt_len: int, steps: int, warmup: int,
             "longctx_tok_s": round(batch * long_steps / long_elapsed, 1),
         }
 
+    spec = {}
+    if spec_tokens > 0:
+        # Speculative verify-step cost: the step's compute is SHAPE-fixed
+        # (acceptance changes which tokens commit, not what runs), so
+        # timing verify steps with replayed rollout drafts gives both the
+        # per-step cost and the full-acceptance throughput envelope
+        # batch*(Kd+1)/step. Acceptance itself is reported informationally:
+        # the replayed drafts mostly accept, but bf16 near-ties can round
+        # differently under the C=Kd+1 chunk than the C=1 rollout, so 100%
+        # is not numerically guaranteed. Prompt-lookup hit rate on the RAG
+        # workload decides where real traffic lands between decode_tok_s
+        # and the envelope.
+        Kd = spec_tokens
+        n_warm, n_timed = 2, 8
+        T = (n_warm + n_timed) * (Kd + 1)
+        assert prompt_len + T <= max_seq_len, "spec bench exceeds seq budget"
+        engine.reset_slots(list(rows))
+        engine.set_page_table_rows(rows)
+        engine.prefill_batch(items)
+        active = jnp.ones((batch,), bool)
+        z = jnp.zeros((batch,), jnp.float32)  # greedy
+        o, zk = jnp.ones((batch,), jnp.float32), jnp.zeros((batch,), jnp.int32)
+        rec = np.stack(
+            [np.asarray(engine.decode(active, z, o, zk)) for _ in range(T)],
+            axis=1,
+        )  # [batch, T] the greedy continuation, replayed as drafts below
+        engine.reset_slots(list(rows))
+        engine.set_page_table_rows(rows)
+        engine.prefill_batch(items)
+        np.asarray(engine.state.context_lens)  # barrier before timing
+
+        def verify_rounds(t0_step: int, n_steps: int) -> tuple[float, list]:
+            counts = []
+            t_start = time.perf_counter()
+            for s in range(t0_step, t0_step + n_steps):
+                t = s * (Kd + 1)
+                _, n_emitted = engine.decode_spec(
+                    active, jnp.asarray(rec[:, t:t + Kd]),
+                    jnp.full((batch,), Kd, jnp.int32), z, o, zk,
+                )
+                counts.append(n_emitted)  # device arrays; no sync in loop
+            np.asarray(counts[-1])  # execution barrier
+            return time.perf_counter() - t_start, counts
+
+        verify_rounds(0, n_warm)  # compile + steady
+        spec_elapsed, counts = verify_rounds(n_warm, n_timed)
+        mean_emitted = float(np.mean([np.asarray(c) for c in counts]))
+        spec_ms = 1000 * spec_elapsed / n_timed
+        spec = {
+            "spec_tokens": Kd,
+            "spec_verify_step_ms": round(spec_ms, 2),
+            "spec_tok_s_full_accept": round(batch * (Kd + 1) / (spec_elapsed / n_timed), 1),
+            "spec_mean_emitted": round(mean_emitted, 2),  # of Kd+1 possible
+        }
+
     return {
         "metric": "decode_tok_s_per_chip",
         "value": round(tok_s, 1),
@@ -273,6 +333,7 @@ def measure(preset: str, batch: int, prompt_len: int, steps: int, warmup: int,
         "prefill_tok_s": round(batch * prompt_len / prefill_s, 1),
         "prefill_compile_s": round(prefill_compile_s, 1),
         **longctx,
+        **spec,
         "platform": jax.devices()[0].platform,
         "device": str(jax.devices()[0]),
     }
@@ -287,7 +348,7 @@ def spawn_worker(args: argparse.Namespace, platform: str, timeout: float) -> dic
            "--platform", platform, "--tpu-timeout", str(args.tpu_timeout),
            "--measure-budget", str(args.measure_budget)]
     for flag in ("preset", "batch", "prompt_len", "steps", "warmup",
-                 "page_size", "max_seq_len", "attn", "quant"):
+                 "page_size", "max_seq_len", "attn", "quant", "spec_tokens"):
         v = getattr(args, flag)
         if v is not None:
             cmd += ["--" + flag.replace("_", "-"), str(v)]

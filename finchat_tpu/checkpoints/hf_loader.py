@@ -57,6 +57,8 @@ def load_llama_params(
     16 GB v5e chip. Same numerics as quantizing after a full-precision
     load.
     """
+    if quant and quant != "int8":
+        raise ValueError(f"unknown quant mode {quant!r} (supported: 'int8')")
     path = Path(checkpoint_dir)
     tensors: dict[str, np.ndarray] = {}
     for shard in _open_shards(path):
@@ -89,18 +91,15 @@ def load_llama_params(
             )
 
     dtype = config.dtype
-    if quant and quant != "int8":
-        raise ValueError(f"unknown quant mode {quant!r} (supported: 'int8')")
 
     def put(path_key: str, array: np.ndarray) -> Any:
         arr = jnp.asarray(array, dtype=dtype)
         if shardings and path_key in shardings:
             arr = jax.device_put(arr, shardings[path_key])
         if quant:
-            from finchat_tpu.models.quant import QUANT_LAYER_LEAVES, quantize
+            from finchat_tpu.models.quant import quantize, should_quantize
 
-            leaf = path_key.rsplit("/", 1)[-1]
-            if leaf in QUANT_LAYER_LEAVES or leaf == "lm_head":
+            if should_quantize(path_key.rsplit("/", 1)[-1]):
                 qt = quantize(arr)
                 # free the bf16 copy before the next tensor materializes
                 jax.block_until_ready(qt.q)

@@ -411,16 +411,21 @@ class ContinuousBatchingScheduler:
             constrained_slots=constrained_slots,
         )
 
+    @staticmethod
+    def _spec_eligible(handle: SequenceHandle) -> bool:
+        """Can this slot benefit from drafts? Greedy, unconstrained, and at
+        least 2 tokens to go (a draft needs room for itself + the bonus)."""
+        return (
+            handle.constraint is None
+            and handle.sampling.temperature <= 0.0
+            and handle.sampling.max_new_tokens - handle.generated >= 2
+        )
+
     def _spec_candidates(self) -> bool:
         """True when at least one decoding slot can benefit from a verify
-        step (greedy, unconstrained, ≥2 tokens to go) — otherwise the
-        pipelined depth-2 decode path is strictly better."""
-        return any(
-            h.constraint is None
-            and h.sampling.temperature <= 0.0
-            and h.sampling.max_new_tokens - h.generated >= 2
-            for h in self.decoding.values()
-        )
+        step — otherwise the pipelined depth-2 decode path is strictly
+        better."""
+        return any(self._spec_eligible(h) for h in self.decoding.values())
 
     def _constrained_pick(self, handle: SequenceHandle, row_logits) -> int:
         """Host-side grammar pick for one constrained slot: choose the
@@ -446,6 +451,8 @@ class ContinuousBatchingScheduler:
         """
         from finchat_tpu.engine.spec import NgramIndex
 
+        if not self.decoding:
+            return  # consuming the drained pipeline step may have evicted all
         inject("scheduler.decode")
         eng = self.engine
         B = eng.engine_cfg.max_seqs
@@ -457,14 +464,10 @@ class ContinuousBatchingScheduler:
         for slot, handle in self.decoding.items():
             active[slot] = True
             members.append((slot, handle))
-            remaining = handle.sampling.max_new_tokens - handle.generated
-            if (
-                handle.constraint is None
-                and handle.sampling.temperature <= 0.0
-                and remaining >= 2
-            ):
+            if self._spec_eligible(handle):
                 if handle.ngram_index is None:  # one-time build; _deliver
                     handle.ngram_index = NgramIndex(handle.history)  # keeps it in sync
+                remaining = handle.sampling.max_new_tokens - handle.generated
                 prop = handle.ngram_index.propose(min(Kd, remaining - 1))
                 drafts[slot, : len(prop)] = prop
                 n_drafts[slot] = len(prop)

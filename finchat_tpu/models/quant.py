@@ -91,6 +91,14 @@ def dense(x: Array, w: Array | QTensor) -> Array:
     return x @ w
 
 
+def should_quantize(name: str) -> bool:
+    """The ONE definition of which param leaves quantize: the layer-stack
+    matmul weights plus the (untied) ``lm_head``. Shared by engine-side
+    quantization, streaming random init, and the per-tensor checkpoint
+    loader so the three paths can never diverge."""
+    return name in QUANT_LAYER_LEAVES or name == "lm_head"
+
+
 def init_quantized_llama_params(config: Any, key: Any) -> dict[str, Any]:
     """Random-init a param tree with matmul weights ALREADY int8 — each
     leaf quantizes at creation (models/llama.py ``leaf_transform``), so the
@@ -101,9 +109,7 @@ def init_quantized_llama_params(config: Any, key: Any) -> dict[str, Any]:
     applied after ``init_params`` (asserted in tests/test_quant.py)."""
 
     def leaf_transform(name: str, w: Any) -> Any:
-        if name in QUANT_LAYER_LEAVES or name == "lm_head":
-            return quantize(w)
-        return w
+        return quantize(w) if should_quantize(name) else w
 
     from finchat_tpu.models.llama import init_params
 
@@ -119,7 +125,7 @@ def quantize_llama_params(params: dict[str, Any]) -> dict[str, Any]:
         return leaf if isinstance(leaf, QTensor) else quantize(leaf)  # idempotent
 
     layers = {
-        name: q(leaf) if name in QUANT_LAYER_LEAVES else leaf
+        name: q(leaf) if should_quantize(name) else leaf
         for name, leaf in params["layers"].items()
     }
     out = {**params, "layers": layers}
