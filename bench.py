@@ -142,6 +142,14 @@ def measure(preset: str, batch: int, prompt_len: int, steps: int, warmup: int,
 
     config = PRESETS[preset]
     attn = attn or attention_backend()
+    if spec_tokens > 0:
+        # fail before ANY device time is spent, not after the main timed
+        # sections (the spec section needs this much sequence room)
+        spec_T = 10 * (spec_tokens + 1)  # (n_warm + n_timed) * (Kd + 1)
+        assert prompt_len + spec_T <= max_seq_len, (
+            f"spec bench needs prompt_len + {spec_T} <= max_seq_len "
+            f"({prompt_len} + {spec_T} > {max_seq_len})"
+        )
     pages_per_seq = pages_needed(max_seq_len, page_size)
     engine_cfg = EngineConfig(
         max_seqs=batch,
@@ -276,8 +284,7 @@ def measure(preset: str, batch: int, prompt_len: int, steps: int, warmup: int,
         # and the envelope.
         Kd = spec_tokens
         n_warm, n_timed = 2, 8
-        T = (n_warm + n_timed) * (Kd + 1)
-        assert prompt_len + T <= max_seq_len, "spec bench exceeds seq budget"
+        T = (n_warm + n_timed) * (Kd + 1)  # must match the spec_T precheck
         engine.reset_slots(list(rows))
         engine.set_page_table_rows(rows)
         engine.prefill_batch(items)
@@ -308,13 +315,25 @@ def measure(preset: str, batch: int, prompt_len: int, steps: int, warmup: int,
 
         verify_rounds(0, n_warm)  # compile + steady
         spec_elapsed, counts = verify_rounds(n_warm, n_timed)
-        mean_emitted = float(np.mean([np.asarray(c) for c in counts]))
+        # acceptance is meaningful only while a slot is ALIGNED with the
+        # replay schedule: after its first rejection the slot's context
+        # falls behind rec's positions and every later step trivially
+        # emits ~1 — include each slot's steps up to and INCLUDING its
+        # first rejection, exclude the misaligned tail
+        counts_np = np.stack([np.asarray(c) for c in counts])  # [n_timed, batch]
+        emitted_vals = []
+        for b in range(batch):
+            col = counts_np[:, b]
+            rejects = np.flatnonzero(col < Kd + 1)
+            end = (rejects[0] + 1) if rejects.size else len(col)
+            emitted_vals.extend(col[:end])
         spec_ms = 1000 * spec_elapsed / n_timed
         spec = {
             "spec_tokens": Kd,
             "spec_verify_step_ms": round(spec_ms, 2),
             "spec_tok_s_full_accept": round(batch * (Kd + 1) / (spec_elapsed / n_timed), 1),
-            "spec_mean_emitted": round(mean_emitted, 2),  # of Kd+1 possible
+            # mean over aligned steps only, of Kd+1 possible
+            "spec_mean_emitted": round(float(np.mean(emitted_vals)), 2),
         }
 
     return {
