@@ -15,16 +15,18 @@ from finchat_tpu.engine.engine import (
     commit_first_token,
     decode_step,
     prefill_step,
+    verify_step,
 )
 from finchat_tpu.engine.kv_cache import PageAllocator, pages_needed
 from finchat_tpu.models.llama import PRESETS, init_params
 from finchat_tpu.utils.config import EngineConfig
 
 
-def _tiny_engine(max_seqs=2):
+def _tiny_engine(max_seqs=2, spec_tokens=0):
     config = PRESETS["tiny"]
     engine_cfg = EngineConfig(
-        max_seqs=max_seqs, page_size=8, num_pages=32, max_seq_len=64, prefill_chunk=8
+        max_seqs=max_seqs, page_size=8, num_pages=32, max_seq_len=64, prefill_chunk=8,
+        spec_tokens=spec_tokens,
     )
     params = init_params(config, jax.random.key(0))
     return InferenceEngine(config, params, engine_cfg, attn_backend="ref")
@@ -66,6 +68,28 @@ def test_first_request_path_compiles_nothing_after_warmup():
     assert prefill_step._cache_size() == sizes["prefill"], "first prefill recompiled"
     assert decode_step._cache_size() == sizes["decode"], "first decode recompiled"
     assert commit_first_token._cache_size() == sizes["commit"], "commit recompiled"
+
+
+def test_warmup_covers_spec_verify_variants():
+    """With spec_tokens > 0 the scheduler's verify path (both return_logits
+    variants) must be compiled at startup, not on the first drafted step."""
+    eng = _tiny_engine(spec_tokens=2)
+    eng.warmup()
+    before = verify_step._cache_size()
+
+    B = eng.engine_cfg.max_seqs
+    active = jnp.zeros((B,), bool).at[0].set(True)
+    drafts = jnp.zeros((B, 2), jnp.int32)
+    n_drafts = jnp.zeros((B,), jnp.int32).at[0].set(2)
+    zeros, ones, zk = jnp.zeros((B,)), jnp.ones((B,)), jnp.zeros((B,), jnp.int32)
+    alloc = PageAllocator(eng.engine_cfg.num_pages)
+    pages = alloc.allocate("s", pages_needed(8, eng.page_size))
+    eng.set_page_table_row(0, pages)
+    eng.prefill(0, [3, 7, 11])
+    eng.decode_spec(active, drafts, n_drafts, zeros, ones, zk)
+    eng.decode_spec(active, drafts, n_drafts, zeros, ones, zk, return_logits=True)
+
+    assert verify_step._cache_size() == before, "first verify step recompiled"
 
 
 def test_warmup_covers_non_power_of_two_max_seqs():
