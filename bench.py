@@ -258,95 +258,95 @@ def measure(preset: str, batch: int, prompt_len: int, steps: int, warmup: int,
     longctx = {}
     if long_prompt_len > prompt_len:
         try:
-              engine.reset_slots(list(rows))
-              engine.set_page_table_rows(rows)
-              long_items = [
-                  (slot, rng.integers(1, config.vocab_size, size=long_prompt_len).tolist())
-                  for slot in range(batch)
-              ]
-              engine.prefill_batch(long_items)
-              np.asarray(engine.state.context_lens)  # barrier (incl. compiles)
-              run_decode_barriered(long_warmup)
-              long_elapsed = run_decode_barriered(long_steps)
-              longctx = {
-                  "longctx_prompt_len": long_prompt_len,
-                  "longctx_decode_steps": long_steps,
-                  "longctx_step_ms": round(1000 * long_elapsed / long_steps, 2),
-                  "longctx_tok_s": round(batch * long_steps / long_elapsed, 1),
-              }
+            engine.reset_slots(list(rows))
+            engine.set_page_table_rows(rows)
+            long_items = [
+                (slot, rng.integers(1, config.vocab_size, size=long_prompt_len).tolist())
+                for slot in range(batch)
+            ]
+            engine.prefill_batch(long_items)
+            np.asarray(engine.state.context_lens)  # barrier (incl. compiles)
+            run_decode_barriered(long_warmup)
+            long_elapsed = run_decode_barriered(long_steps)
+            longctx = {
+                "longctx_prompt_len": long_prompt_len,
+                "longctx_decode_steps": long_steps,
+                "longctx_step_ms": round(1000 * long_elapsed / long_steps, 2),
+                "longctx_tok_s": round(batch * long_steps / long_elapsed, 1),
+            }
         except Exception as e:  # pragma: no cover - defensive, driver-run path
             print(f"[bench] longctx section failed: {e}", file=sys.stderr, flush=True)
             longctx = {"longctx_error": str(e)[:200]}
 
     spec = {}
     if spec_tokens > 0:
-      try:
-        # Speculative verify-step cost: the step's compute is SHAPE-fixed
-        # (acceptance changes which tokens commit, not what runs), so
-        # timing verify steps with replayed rollout drafts gives both the
-        # per-step cost and the full-acceptance throughput envelope
-        # batch*(Kd+1)/step. Acceptance itself is reported informationally:
-        # the replayed drafts mostly accept, but bf16 near-ties can round
-        # differently under the C=Kd+1 chunk than the C=1 rollout, so 100%
-        # is not numerically guaranteed. Prompt-lookup hit rate on the RAG
-        # workload decides where real traffic lands between decode_tok_s
-        # and the envelope.
-        Kd = spec_tokens
-        n_warm, n_timed = 2, 8
-        T = (n_warm + n_timed) * (Kd + 1)  # must match the spec_T precheck
-        engine.reset_slots(list(rows))
-        engine.set_page_table_rows(rows)
-        engine.prefill_batch(items)
-        active = jnp.ones((batch,), bool)
-        z = jnp.zeros((batch,), jnp.float32)  # greedy
-        o, zk = jnp.ones((batch,), jnp.float32), jnp.zeros((batch,), jnp.int32)
-        rec = np.stack(
-            [np.asarray(engine.decode(active, z, o, zk)) for _ in range(T)],
-            axis=1,
-        )  # [batch, T] the greedy continuation, replayed as drafts below
-        engine.reset_slots(list(rows))
-        engine.set_page_table_rows(rows)
-        engine.prefill_batch(items)
-        np.asarray(engine.state.context_lens)  # barrier before timing
+        try:
+            # Speculative verify-step cost: the step's compute is SHAPE-fixed
+            # (acceptance changes which tokens commit, not what runs), so
+            # timing verify steps with replayed rollout drafts gives both the
+            # per-step cost and the full-acceptance throughput envelope
+            # batch*(Kd+1)/step. Acceptance itself is reported informationally:
+            # the replayed drafts mostly accept, but bf16 near-ties can round
+            # differently under the C=Kd+1 chunk than the C=1 rollout, so 100%
+            # is not numerically guaranteed. Prompt-lookup hit rate on the RAG
+            # workload decides where real traffic lands between decode_tok_s
+            # and the envelope.
+            Kd = spec_tokens
+            n_warm, n_timed = 2, 8
+            T = (n_warm + n_timed) * (Kd + 1)  # must match the spec_T precheck
+            engine.reset_slots(list(rows))
+            engine.set_page_table_rows(rows)
+            engine.prefill_batch(items)
+            active = jnp.ones((batch,), bool)
+            z = jnp.zeros((batch,), jnp.float32)  # greedy
+            o, zk = jnp.ones((batch,), jnp.float32), jnp.zeros((batch,), jnp.int32)
+            rec = np.stack(
+                [np.asarray(engine.decode(active, z, o, zk)) for _ in range(T)],
+                axis=1,
+            )  # [batch, T] the greedy continuation, replayed as drafts below
+            engine.reset_slots(list(rows))
+            engine.set_page_table_rows(rows)
+            engine.prefill_batch(items)
+            np.asarray(engine.state.context_lens)  # barrier before timing
 
-        def verify_rounds(t0_step: int, n_steps: int) -> tuple[float, list]:
-            counts = []
-            t_start = time.perf_counter()
-            for s in range(t0_step, t0_step + n_steps):
-                t = s * (Kd + 1)
-                _, n_emitted = engine.decode_spec(
-                    active, jnp.asarray(rec[:, t:t + Kd]),
-                    jnp.full((batch,), Kd, jnp.int32), z, o, zk,
-                )
-                counts.append(n_emitted)  # device arrays; no sync in loop
-            np.asarray(counts[-1])  # execution barrier
-            return time.perf_counter() - t_start, counts
+            def verify_rounds(t0_step: int, n_steps: int) -> tuple[float, list]:
+                counts = []
+                t_start = time.perf_counter()
+                for s in range(t0_step, t0_step + n_steps):
+                    t = s * (Kd + 1)
+                    _, n_emitted = engine.decode_spec(
+                        active, jnp.asarray(rec[:, t:t + Kd]),
+                        jnp.full((batch,), Kd, jnp.int32), z, o, zk,
+                    )
+                    counts.append(n_emitted)  # device arrays; no sync in loop
+                np.asarray(counts[-1])  # execution barrier
+                return time.perf_counter() - t_start, counts
 
-        verify_rounds(0, n_warm)  # compile + steady
-        spec_elapsed, counts = verify_rounds(n_warm, n_timed)
-        # acceptance is meaningful only while a slot is ALIGNED with the
-        # replay schedule: after its first rejection the slot's context
-        # falls behind rec's positions and every later step trivially
-        # emits ~1 — include each slot's steps up to and INCLUDING its
-        # first rejection, exclude the misaligned tail
-        counts_np = np.stack([np.asarray(c) for c in counts])  # [n_timed, batch]
-        emitted_vals = []
-        for b in range(batch):
-            col = counts_np[:, b]
-            rejects = np.flatnonzero(col < Kd + 1)
-            end = (rejects[0] + 1) if rejects.size else len(col)
-            emitted_vals.extend(col[:end])
-        spec_ms = 1000 * spec_elapsed / n_timed
-        spec = {
-            "spec_tokens": Kd,
-            "spec_verify_step_ms": round(spec_ms, 2),
-            "spec_tok_s_full_accept": round(batch * (Kd + 1) / (spec_elapsed / n_timed), 1),
-            # mean over aligned steps only, of Kd+1 possible
-            "spec_mean_emitted": round(float(np.mean(emitted_vals)), 2),
-        }
-      except Exception as e:  # pragma: no cover - defensive, driver-run path
-        print(f"[bench] spec section failed: {e}", file=sys.stderr, flush=True)
-        spec = {"spec_error": str(e)[:200]}
+            verify_rounds(0, n_warm)  # compile + steady
+            spec_elapsed, counts = verify_rounds(n_warm, n_timed)
+            # acceptance is meaningful only while a slot is ALIGNED with the
+            # replay schedule: after its first rejection the slot's context
+            # falls behind rec's positions and every later step trivially
+            # emits ~1 — include each slot's steps up to and INCLUDING its
+            # first rejection, exclude the misaligned tail
+            counts_np = np.stack([np.asarray(c) for c in counts])  # [n_timed, batch]
+            emitted_vals = []
+            for b in range(batch):
+                col = counts_np[:, b]
+                rejects = np.flatnonzero(col < Kd + 1)
+                end = (rejects[0] + 1) if rejects.size else len(col)
+                emitted_vals.extend(col[:end])
+            spec_ms = 1000 * spec_elapsed / n_timed
+            spec = {
+                "spec_tokens": Kd,
+                "spec_verify_step_ms": round(spec_ms, 2),
+                "spec_tok_s_full_accept": round(batch * (Kd + 1) / (spec_elapsed / n_timed), 1),
+                # mean over aligned steps only, of Kd+1 possible
+                "spec_mean_emitted": round(float(np.mean(emitted_vals)), 2),
+            }
+        except Exception as e:  # pragma: no cover - defensive, driver-run path
+            print(f"[bench] spec section failed: {e}", file=sys.stderr, flush=True)
+            spec = {"spec_error": str(e)[:200]}
 
     return {
         "metric": "decode_tok_s_per_chip",

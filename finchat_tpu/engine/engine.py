@@ -180,17 +180,28 @@ def prefill_step(
     return new_state, last_logits
 
 
-def _ring_prefill_attention_fn(mesh, page_table: Array, start_pos: Array, n_valid: Array, page_size: int):
-    """Attention callback for the seq-sharded long-prompt prefill: ring
-    attention over the ``seq`` mesh axis for the compute, XLA scatter for
-    the cache write (one cache copy amortized over the WHOLE prompt)."""
-    from finchat_tpu.ops.ring_attention import ring_attention
+def _ring_prefill_attention_fn(mesh, page_table: Array, start_pos: Array, n_valid: Array,
+                               page_size: int, sp_mode: str = "ring"):
+    """Attention callback for the seq-sharded long-prompt prefill: SP
+    attention over the ``seq`` mesh axis for the compute — ring (K/V blocks
+    rotate the ICI ring) or Ulysses (all-to-all head scatter, SURVEY
+    §5.7d) per ``sp_mode`` — and an XLA scatter for the cache write (one
+    cache copy amortized over the WHOLE prompt)."""
 
     def attention(q: Array, k: Array, v: Array, cache: Any, layer_idx: Array):
         k_pages, v_pages = cache
-        out = ring_attention(
-            q, k, v, mesh=mesh, axis="seq", head_axis="model", causal=True
-        )
+        if sp_mode == "ulysses":
+            from finchat_tpu.ops.ulysses import ulysses_attention
+
+            out = ulysses_attention(
+                q, k, v, mesh=mesh, axis="seq", head_axis="model", causal=True
+            )
+        else:
+            from finchat_tpu.ops.ring_attention import ring_attention
+
+            out = ring_attention(
+                q, k, v, mesh=mesh, axis="seq", head_axis="model", causal=True
+            )
         k_pages, v_pages = scatter_kv_chunk(
             k_pages, v_pages, k, v, page_table, start_pos, n_valid,
             page_size, layer_idx,
@@ -200,7 +211,7 @@ def _ring_prefill_attention_fn(mesh, page_table: Array, start_pos: Array, n_vali
     return attention
 
 
-@partial(jax.jit, static_argnames=("config", "page_size", "mesh"), donate_argnums=(1,))
+@partial(jax.jit, static_argnames=("config", "page_size", "mesh", "sp_mode"), donate_argnums=(1,))
 def ring_prefill_step(
     params: dict[str, Any],
     state: DecodeState,
@@ -211,20 +222,24 @@ def ring_prefill_step(
     config: LlamaConfig,
     page_size: int,
     mesh,
+    sp_mode: str = "ring",
 ) -> tuple[DecodeState, Array]:
     """Seq-sharded single-shot prefill for long RAG prompts (SURVEY §5.7c).
 
     The sequence dim is sharded over the mesh's ``seq`` axis: activations
-    and attention state are O(S / seq) per device, with K/V blocks rotating
-    the ICI ring (ops/ring_attention.py) — prompts beyond one chip's HBM
-    become servable. Composes with TP (``model`` axis) via the head axis.
+    and attention state are O(S / seq) per device, with the cross-device
+    exchange done per ``sp_mode`` — K/V blocks rotating the ICI ring
+    (ops/ring_attention.py) or Ulysses all-to-all head scatter
+    (ops/ulysses.py) — so prompts beyond one chip's HBM become servable.
+    Composes with TP (``model`` axis) via the head axis.
     Returns (state, last-valid-token logits [vocab])."""
     S = tokens.shape[1]
     positions = jnp.arange(S)[None, :]  # [1, S]
     page_row = jax.lax.dynamic_slice_in_dim(state.page_table, slot, 1, axis=0)
 
     attention = _ring_prefill_attention_fn(
-        mesh, page_row, jnp.zeros((1,), jnp.int32), n_valid[None], page_size
+        mesh, page_row, jnp.zeros((1,), jnp.int32), n_valid[None], page_size,
+        sp_mode,
     )
     # hidden states only — a full [S, vocab] fp32 logits tensor at long-S
     # would cost GBs in exactly the regime this path exists for; project
@@ -458,6 +473,27 @@ class InferenceEngine:
         self.quant = quant
         self.params = params
         self.state = state
+        self.sp_mode = self._resolve_sp_mode(engine_cfg.sp_mode)
+
+    def _resolve_sp_mode(self, sp_mode: str) -> str:
+        """Validate the configured SP mode against this model/mesh; Ulysses
+        needs per-TP-shard head counts divisible by the seq axis
+        (ops/ulysses.py) — fall back to ring (always valid) otherwise."""
+        if sp_mode not in ("ring", "ulysses"):
+            raise ValueError(f"unknown sp_mode {sp_mode!r} (supported: 'ring', 'ulysses')")
+        if sp_mode == "ulysses" and self.mesh is not None:
+            from finchat_tpu.ops.ulysses import ulysses_supported
+
+            c = self.config
+            if not ulysses_supported(c.n_heads, c.n_kv_heads, self.mesh,
+                                     axis="seq", head_axis="model"):
+                logger.warning(
+                    "sp_mode=ulysses needs per-shard heads divisible by the seq "
+                    "axis (H=%d, Hkv=%d, mesh=%s); falling back to ring",
+                    c.n_heads, c.n_kv_heads, dict(self.mesh.shape),
+                )
+                return "ring"
+        return sp_mode
 
     # --- low-level ops used by the scheduler ----------------------------
     def set_page_table_row(self, slot: int, pages: list[int]) -> None:
@@ -523,6 +559,7 @@ class InferenceEngine:
         self.state, last_logits = ring_prefill_step(
             self.params, self.state, tokens, jnp.int32(slot), jnp.int32(n),
             config=self.config, page_size=self.page_size, mesh=self.mesh,
+            sp_mode=self.sp_mode,
         )
         return last_logits
 
@@ -669,7 +706,7 @@ class InferenceEngine:
                     self.params, self.state, jnp.zeros((1, S), jnp.int32),
                     jnp.int32(0), jnp.int32(0),
                     config=self.config, page_size=self.page_size,
-                    mesh=self.mesh,
+                    mesh=self.mesh, sp_mode=self.sp_mode,
                 )
                 if S >= top:
                     break

@@ -52,6 +52,22 @@ def _ulysses_body(q, k, v, *, axis: str, causal: bool):
     return heads_to_seq(out_h)
 
 
+def ulysses_supported(
+    n_heads: int, n_kv_heads: int, mesh: Mesh,
+    axis: str = "seq", head_axis: str | None = None,
+) -> bool:
+    """THE divisibility predicate for the head scatter — shared by
+    ``ulysses_attention``'s own check and the engine's sp_mode resolution
+    (engine/engine.py) so the two can never drift: per-TP-shard head
+    counts (query AND kv) must divide by the seq-axis extent."""
+    n = mesh.shape.get(axis, 1)
+    tp = mesh.shape.get(head_axis, 1) if head_axis else 1
+    return not (
+        n_heads % tp or n_kv_heads % tp
+        or (n_heads // tp) % n or (n_kv_heads // tp) % n
+    )
+
+
 @partial(jax.jit, static_argnames=("mesh", "axis", "batch_axis", "head_axis", "causal"))
 def ulysses_attention(
     q: jax.Array,  # [B, S, H, D] sharded on S over `axis`
@@ -72,13 +88,11 @@ def ulysses_attention(
     ``n = mesh.shape[axis]`` (checked); callers fall back to ring attention
     otherwise.
     """
-    n = mesh.shape[axis]
-    tp = mesh.shape[head_axis] if head_axis else 1
     H, Hkv = q.shape[2], k.shape[2]
-    if H % tp or Hkv % tp or (H // tp) % n or (Hkv // tp) % n:
+    if not ulysses_supported(H, Hkv, mesh, axis=axis, head_axis=head_axis):
         raise ValueError(
             f"ulysses needs per-shard heads divisible by the seq axis: "
-            f"H={H}, Hkv={Hkv}, tp={tp}, n={n} — use ring attention instead"
+            f"H={H}, Hkv={Hkv}, mesh={dict(mesh.shape)} — use ring attention instead"
         )
     spec = P(batch_axis, axis, head_axis, None)
     fn = jax.shard_map(

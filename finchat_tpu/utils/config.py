@@ -178,6 +178,13 @@ class EngineConfig:
     # prompt-lookup (engine/spec.py); 0 = off. Greedy-exact — RAG answers
     # quote retrieved rows, so drafts hit often on the product workload.
     spec_tokens: int = 0
+    # sequence-parallel mode for the seq-sharded long-prompt serving
+    # prefill (SURVEY §5.7c/d): "ring" (K/V blocks rotate the ICI ring;
+    # works for any head count, S beyond one chip's HBM) or "ulysses"
+    # (two all-to-alls + full-sequence attention per head group; fewer
+    # collectives when heads divide the seq axis — falls back to ring
+    # when they don't)
+    sp_mode: str = "ring"
 
 
 @dataclass
@@ -269,6 +276,7 @@ def load_config(
         "FINCHAT_RING_PREFILL_MIN", cfg.engine.ring_prefill_min_tokens
     )
     cfg.engine.spec_tokens = _env_int("FINCHAT_SPEC_TOKENS", cfg.engine.spec_tokens)
+    cfg.engine.sp_mode = _env("FINCHAT_SP_MODE", cfg.engine.sp_mode)
     cfg.serve.port = _env_int("FINCHAT_PORT", cfg.serve.port)
 
     # --- optional JSON config file ---

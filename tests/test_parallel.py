@@ -187,6 +187,62 @@ def test_ring_prefill_serving_matches_chunked():
     assert ring_tokens == mesh_tokens == plain_tokens
 
 
+def test_ulysses_serving_prefill_matches_chunked():
+    """SURVEY §5.7d: sp_mode='ulysses' must serve the seq-sharded long
+    prefill with the same greedy continuation as chunked prefill, and an
+    indivisible head count must fall back to ring rather than fail."""
+    import numpy as np
+
+    from finchat_tpu.engine.engine import InferenceEngine, commit_first_token
+    from finchat_tpu.engine.kv_cache import PageAllocator, pages_needed
+    from finchat_tpu.utils.config import EngineConfig
+
+    config = LlamaConfig(
+        vocab_size=128, dim=64, n_layers=2, n_heads=8, n_kv_heads=4,
+        hidden_dim=128, max_seq_len=128,
+    )
+    params = init_params(config, jax.random.key(0))
+    prompt = list(np.random.RandomState(5).randint(1, 128, size=50))
+    n_new = 5
+    # seq=2, model=2: per-shard H=4, Hkv=2 — both divisible by seq ✓
+    mesh = build_mesh(MeshSpec(data=2, seq=2, expert=1, model=2))
+
+    def run(sp_mode, ring_min):
+        ecfg = EngineConfig(
+            max_seqs=2, page_size=8, num_pages=32, max_seq_len=128,
+            prefill_chunk=16, ring_prefill_min_tokens=ring_min, sp_mode=sp_mode,
+        )
+        eng = InferenceEngine(config, params, ecfg, mesh=mesh)
+        if sp_mode == "ulysses" and ring_min <= len(prompt):
+            assert eng.sp_mode == "ulysses"  # no silent fallback in this shape
+        alloc = PageAllocator(ecfg.num_pages)
+        pages = alloc.allocate("s", pages_needed(len(prompt) + n_new, 8))
+        eng.set_page_table_row(0, pages)
+        logits = eng.prefill(0, prompt)
+        eng.state, tok = commit_first_token(
+            eng.state, jnp.int32(0), logits, jnp.float32(0.0), jnp.float32(1.0), jnp.int32(0)
+        )
+        out = [int(tok)]
+        active = jnp.zeros((2,), bool).at[0].set(True)
+        z, o, zk = jnp.zeros((2,)), jnp.ones((2,)), jnp.zeros((2,), jnp.int32)
+        for _ in range(n_new - 1):
+            out.append(int(eng.decode(active, z, o, zk)[0]))
+        return out
+
+    ulysses_tokens = run("ulysses", ring_min=16)  # seq-sharded path engaged
+    chunked_tokens = run("ring", ring_min=10_000)  # chunked on the same mesh
+    assert ulysses_tokens == chunked_tokens
+
+    # fallback: seq axis does not divide per-shard KV heads on this mesh
+    bad_mesh = build_mesh(MeshSpec(data=1, seq=2, expert=1, model=4))
+    ecfg = EngineConfig(
+        max_seqs=2, page_size=8, num_pages=32, max_seq_len=128,
+        prefill_chunk=16, ring_prefill_min_tokens=16, sp_mode="ulysses",
+    )
+    eng = InferenceEngine(config, params, ecfg, mesh=bad_mesh)
+    assert eng.sp_mode == "ring"  # Hkv/tp = 1 not divisible by seq=2
+
+
 def test_scheduler_routes_long_prompts_through_ring_prefill():
     """The SERVING path (scheduler), not just the engine API, must engage
     the seq-sharded ring prefill for long prompts on a seq>1 mesh."""

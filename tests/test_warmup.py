@@ -83,7 +83,8 @@ def test_warmup_covers_spec_verify_variants():
     n_drafts = jnp.zeros((B,), jnp.int32).at[0].set(2)
     zeros, ones, zk = jnp.zeros((B,)), jnp.ones((B,)), jnp.zeros((B,), jnp.int32)
     alloc = PageAllocator(eng.engine_cfg.num_pages)
-    pages = alloc.allocate("s", pages_needed(8, eng.page_size))
+    # 3 prompt tokens + two verify steps that can each commit spec+1 = 3
+    pages = alloc.allocate("s", pages_needed(3 + 2 * 3, eng.page_size))
     eng.set_page_table_row(0, pages)
     eng.prefill(0, [3, 7, 11])
     eng.decode_spec(active, drafts, n_drafts, zeros, ones, zk)
