@@ -65,6 +65,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--quant", choices=("int8",), default=None,
                    help="serve int8 weight-only quantized params "
                         "(models/quant.py); default bf16")
+    p.add_argument("--kv-quant", choices=("int8",), default=None,
+                   help="int8 paged-KV cache (per-token-per-head scales); "
+                        "default: model dtype")
     p.add_argument("--spec-tokens", type=int, default=None,
                    help="also measure the speculative verify step at this "
                         "draft width (engine/spec.py): cost per step and "
@@ -121,6 +124,7 @@ def run_worker(args: argparse.Namespace) -> int:
 
     work = resolve_workload(args, "tpu" if platform == "tpu" else "cpu")
     result = measure(attn=args.attn, quant=args.quant or "",
+                     kv_quant=args.kv_quant or "",
                      spec_tokens=args.spec_tokens or 0, **work)
     result["backend_init_s"] = round(init_s, 1)
     print(json.dumps(result), flush=True)
@@ -129,7 +133,7 @@ def run_worker(args: argparse.Namespace) -> int:
 
 def measure(preset: str, batch: int, prompt_len: int, steps: int, warmup: int,
             page_size: int, max_seq_len: int, attn: str | None,
-            quant: str = "", spec_tokens: int = 0) -> dict:
+            quant: str = "", kv_quant: str = "", spec_tokens: int = 0) -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -158,6 +162,7 @@ def measure(preset: str, batch: int, prompt_len: int, steps: int, warmup: int,
         num_pages=batch * pages_per_seq + 8,
         max_seq_len=max_seq_len,
         prefill_chunk=max(prompt_len, 128),
+        kv_quant=kv_quant,
     )
 
     if quant:
@@ -356,6 +361,7 @@ def measure(preset: str, batch: int, prompt_len: int, steps: int, warmup: int,
         "model": preset,
         "attn": attn,
         "quant": quant or "bf16",
+        "kv_quant": kv_quant or "off",
         "batch": batch,
         "prompt_len": prompt_len,
         "decode_steps": steps,
@@ -379,7 +385,8 @@ def spawn_worker(args: argparse.Namespace, platform: str, timeout: float) -> dic
            "--platform", platform, "--tpu-timeout", str(args.tpu_timeout),
            "--measure-budget", str(args.measure_budget)]
     for flag in ("preset", "batch", "prompt_len", "steps", "warmup",
-                 "page_size", "max_seq_len", "attn", "quant", "spec_tokens"):
+                 "page_size", "max_seq_len", "attn", "quant", "kv_quant",
+                 "spec_tokens"):
         v = getattr(args, flag)
         if v is not None:
             cmd += ["--" + flag.replace("_", "-"), str(v)]

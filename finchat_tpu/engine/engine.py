@@ -56,10 +56,16 @@ def round_up_pow2(n: int) -> int:
 @jax.tree_util.register_dataclass
 @dataclass
 class DecodeState:
-    """Device-resident engine state (a pytree; all leaves are arrays)."""
+    """Device-resident engine state (a pytree; all leaves are arrays).
 
-    k_pages: Array  # [L, P, page_size, Hkv*hd]
+    ``k_scales``/``v_scales`` are the int8-KV-cache scale arrays
+    (kv_cache.py); (1,1,1,1) placeholders when kv_quant is off so the
+    pytree structure is mode-independent."""
+
+    k_pages: Array  # [L, P, page_size, Hkv*hd] (model dtype, or int8)
     v_pages: Array
+    k_scales: Array  # [L, P, scale_rows, page_size] fp32 (or (1,1,1,1))
+    v_scales: Array
     page_table: Array  # [max_seqs, max_pages_per_seq] int32 (0 = trash)
     context_lens: Array  # [max_seqs] int32 — tokens whose KV is cached
     last_tokens: Array  # [max_seqs] int32 — next decode input per slot
@@ -67,12 +73,17 @@ class DecodeState:
 
 
 def create_state(
-    config: LlamaConfig, engine_cfg: EngineConfig, max_pages_per_seq: int
+    config: LlamaConfig, engine_cfg: EngineConfig, max_pages_per_seq: int,
+    kv_quant: str = "",
 ) -> DecodeState:
-    cache = PagedKVCache.create(config, engine_cfg.num_pages, engine_cfg.page_size)
+    cache = PagedKVCache.create(
+        config, engine_cfg.num_pages, engine_cfg.page_size, kv_quant=kv_quant
+    )
     return DecodeState(
         k_pages=cache.k_pages,
         v_pages=cache.v_pages,
+        k_scales=cache.k_scales,
+        v_scales=cache.v_scales,
         page_table=jnp.zeros((engine_cfg.max_seqs, max_pages_per_seq), jnp.int32),
         context_lens=jnp.zeros((engine_cfg.max_seqs,), jnp.int32),
         last_tokens=jnp.zeros((engine_cfg.max_seqs,), jnp.int32),
@@ -103,39 +114,60 @@ def _paged_attention_fn(
     def attention(q: Array, k: Array, v: Array, cache: Any, layer_idx: Array):
         from finchat_tpu.utils.tracing import named_scope
 
-        k_pages, v_pages = cache
+        k_pages, v_pages, k_scales, v_scales = cache
+        quantized = k_pages.dtype == jnp.int8  # static under trace
         B, C = k.shape[:2]
         layer = layer_idx.reshape(1)
         if (C == 1 or inplace_append) and attn_backend != "ref":
             # decode / spec verify: in-place single-page RMW appends (no
             # cache copy); token i of the chunk is valid iff i < n_valid
-            from finchat_tpu.ops.kv_append import paged_kv_append
-
             with named_scope("kv_append"):
                 for i in range(C):
                     kv_new = jnp.concatenate(
                         [k[:, i].reshape(B, 1, -1), v[:, i].reshape(B, 1, -1)],
                         axis=-1,
                     )
-                    k_pages, v_pages = paged_kv_append(
-                        kv_new, k_pages, v_pages, page_table, start_pos + i,
-                        (i < n_valid).astype(jnp.int32),
-                        layer, page_size=page_size, interpret=interpret,
-                    )
+                    i_valid = (i < n_valid).astype(jnp.int32)
+                    if quantized:
+                        from finchat_tpu.ops.kv_append import paged_kv_append_q8
+
+                        k_pages, v_pages, k_scales, v_scales = paged_kv_append_q8(
+                            kv_new, k_pages, v_pages, k_scales, v_scales,
+                            page_table, start_pos + i, i_valid, layer,
+                            page_size=page_size, n_kv=n_kv, interpret=interpret,
+                        )
+                    else:
+                        from finchat_tpu.ops.kv_append import paged_kv_append
+
+                        k_pages, v_pages = paged_kv_append(
+                            kv_new, k_pages, v_pages, page_table, start_pos + i,
+                            i_valid, layer, page_size=page_size, interpret=interpret,
+                        )
         else:
             # prefill chunk (or jnp reference path): XLA scatter — one
             # cache copy amortized over the whole batched chunk
             with named_scope("kv_scatter"):
-                k_pages, v_pages = scatter_kv_chunk(
-                    k_pages, v_pages, k, v, page_table, start_pos, n_valid,
-                    page_size, layer_idx,
-                )
+                if quantized:
+                    from finchat_tpu.engine.kv_cache import scatter_kv_chunk_q8
+
+                    k_pages, v_pages, k_scales, v_scales = scatter_kv_chunk_q8(
+                        k_pages, v_pages, k_scales, v_scales, k, v,
+                        page_table, start_pos, n_valid, page_size, layer_idx,
+                        n_kv,
+                    )
+                else:
+                    k_pages, v_pages = scatter_kv_chunk(
+                        k_pages, v_pages, k, v, page_table, start_pos, n_valid,
+                        page_size, layer_idx,
+                    )
         with named_scope("paged_attention"):
             out = paged_attention(
                 q, k_pages, v_pages, page_table, start_pos, start_pos + n_valid,
                 layer, page_size=page_size, n_kv=n_kv, backend=attn_backend,
+                k_scales=k_scales if quantized else None,
+                v_scales=v_scales if quantized else None,
             )
-        return out, (k_pages, v_pages)
+        return out, (k_pages, v_pages, k_scales, v_scales)
 
     return attention
 
@@ -162,10 +194,10 @@ def prefill_step(
     attention = _paged_attention_fn(
         page_rows, start_pos, n_valid, page_size, config.n_kv_heads, attn_backend
     )
-    logits, (k_pages, v_pages) = forward(
+    logits, (k_pages, v_pages, k_scales, v_scales) = forward(
         params, tokens, positions,
         config=config, attention=attention,
-        cache=(state.k_pages, state.v_pages),
+        cache=(state.k_pages, state.v_pages, state.k_scales, state.v_scales),
     )
     last_logits = jnp.take_along_axis(
         logits, jnp.maximum(n_valid - 1, 0)[:, None, None], axis=1
@@ -175,6 +207,8 @@ def prefill_step(
         state,
         k_pages=k_pages,
         v_pages=v_pages,
+        k_scales=k_scales,
+        v_scales=v_scales,
         context_lens=state.context_lens.at[slots].add(n_valid),
     )
     return new_state, last_logits
@@ -189,7 +223,7 @@ def _ring_prefill_attention_fn(mesh, page_table: Array, start_pos: Array, n_vali
     cache copy amortized over the WHOLE prompt)."""
 
     def attention(q: Array, k: Array, v: Array, cache: Any, layer_idx: Array):
-        k_pages, v_pages = cache
+        k_pages, v_pages, k_scales, v_scales = cache
         if sp_mode == "ulysses":
             from finchat_tpu.ops.ulysses import ulysses_attention
 
@@ -202,11 +236,14 @@ def _ring_prefill_attention_fn(mesh, page_table: Array, start_pos: Array, n_vali
             out = ring_attention(
                 q, k, v, mesh=mesh, axis="seq", head_axis="model", causal=True
             )
+        # NOTE: no int8 write branch here on purpose — SP prefill requires
+        # a mesh and the engine disables kv_quant under a mesh (single-chip
+        # only for now), so an int8 cache can never reach this path
         k_pages, v_pages = scatter_kv_chunk(
             k_pages, v_pages, k, v, page_table, start_pos, n_valid,
             page_size, layer_idx,
         )
-        return out, (k_pages, v_pages)
+        return out, (k_pages, v_pages, k_scales, v_scales)
 
     return attention
 
@@ -246,10 +283,10 @@ def ring_prefill_step(
     # the single last-valid row instead
     from finchat_tpu.models.llama import lm_head
 
-    hidden, (k_pages, v_pages) = forward(
+    hidden, (k_pages, v_pages, k_scales, v_scales) = forward(
         params, tokens, positions,
         config=config, attention=attention,
-        cache=(state.k_pages, state.v_pages),
+        cache=(state.k_pages, state.v_pages, state.k_scales, state.v_scales),
         return_hidden=True,
     )
     last_hidden = jax.lax.dynamic_index_in_dim(
@@ -261,6 +298,8 @@ def ring_prefill_step(
         state,
         k_pages=k_pages,
         v_pages=v_pages,
+        k_scales=k_scales,
+        v_scales=v_scales,
         context_lens=state.context_lens.at[slot].add(n_valid),
     )
     return new_state, last_logits
@@ -318,10 +357,10 @@ def decode_step(
         state.page_table, state.context_lens, n_valid,
         page_size, config.n_kv_heads, attn_backend,
     )
-    logits, (k_pages, v_pages) = forward(
+    logits, (k_pages, v_pages, k_scales, v_scales) = forward(
         params, tokens, positions,
         config=config, attention=attention,
-        cache=(state.k_pages, state.v_pages),
+        cache=(state.k_pages, state.v_pages, state.k_scales, state.v_scales),
     )
     step_logits = logits[:, 0, :]  # [B, vocab]
 
@@ -332,6 +371,8 @@ def decode_step(
         state,
         k_pages=k_pages,
         v_pages=v_pages,
+        k_scales=k_scales,
+        v_scales=v_scales,
         context_lens=state.context_lens + n_valid,
         last_tokens=jnp.where(active, next_tokens, state.last_tokens),
         rng=rng,
@@ -395,10 +436,10 @@ def verify_step(
         state.page_table, state.context_lens, n_valid,
         page_size, config.n_kv_heads, attn_backend, inplace_append=True,
     )
-    logits, (k_pages, v_pages) = forward(
+    logits, (k_pages, v_pages, k_scales, v_scales) = forward(
         params, tokens, positions,
         config=config, attention=attention,
-        cache=(state.k_pages, state.v_pages),
+        cache=(state.k_pages, state.v_pages, state.k_scales, state.v_scales),
     )  # [B, K, vocab]
 
     preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, K]
@@ -421,6 +462,8 @@ def verify_step(
         state,
         k_pages=k_pages,
         v_pages=v_pages,
+        k_scales=k_scales,
+        v_scales=v_scales,
         context_lens=state.context_lens + n_emitted,
         last_tokens=jnp.where(active, last, state.last_tokens),
         rng=rng,
@@ -451,7 +494,16 @@ class InferenceEngine:
             -(-engine_cfg.max_seq_len // engine_cfg.page_size),
         )
         self.mesh = mesh
-        state = create_state(config, engine_cfg, self.max_pages_per_seq)
+        kv_quant = engine_cfg.kv_quant
+        if kv_quant and mesh is not None:
+            # the scale arrays' padded head-row dim has no TP sharding story
+            # yet (shard_decode_state shards KV pages over the fused head
+            # minor dim); single-chip serving is the target use case — the
+            # 16 GB v5e with int8 weights + int8 KV
+            logger.warning("kv_quant=%s is single-chip only for now; disabling under a mesh", kv_quant)
+            kv_quant = ""
+        self.kv_quant = kv_quant
+        state = create_state(config, engine_cfg, self.max_pages_per_seq, kv_quant=kv_quant)
         if mesh is not None:
             # TP placement: params sharded Megatron-style, KV pages sharded
             # over the fused KV-head dim on the model axis; XLA propagates

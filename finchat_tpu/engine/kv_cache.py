@@ -43,36 +43,70 @@ logger = get_logger(__name__)
 TRASH_PAGE = 0
 
 
+def scale_rows(n_kv: int) -> int:
+    """Rows of the per-page scale block: KV heads padded to a sublane
+    multiple so the ``[rows, page_size]`` trailing dims of the scale arrays
+    are Mosaic-tile-aligned (fp32 tiles are (8, 128))."""
+    return -(-n_kv // 8) * 8
+
+
 @dataclass
 class PagedKVCache:
     """Device-side paged cache tensors (a pytree; the leading layer axis is
     carried through the model's ``lax.scan`` and indexed per layer by the
-    kernels via scalar prefetch)."""
+    kernels via scalar prefetch).
 
-    k_pages: Any  # [L, P, page_size, Hkv * head_dim]
+    ``kv_quant="int8"`` stores pages as int8 with PER-TOKEN-PER-HEAD fp32
+    scales in parallel ``[L, P, scale_rows, page_size]`` arrays (~6%
+    overhead at head_dim 64): each token row is quantized independently at
+    write time, so the append kernel's page RMW never requantizes existing
+    rows — no drift — and per-step HBM traffic for the KV read halves.
+    When off, the scale leaves are kept as (1,1,1,1) placeholders so the
+    engine state pytree structure is identical in both modes."""
+
+    k_pages: Any  # [L, P, page_size, Hkv * head_dim] (dtype or int8)
     v_pages: Any
+    k_scales: Any  # [L, P, scale_rows(Hkv), page_size] fp32 (or (1,1,1,1))
+    v_scales: Any
     page_size: int
     num_pages: int
+    kv_quant: str = ""
 
     @classmethod
-    def create(cls, config: LlamaConfig, num_pages: int, page_size: int) -> "PagedKVCache":
+    def create(cls, config: LlamaConfig, num_pages: int, page_size: int,
+               kv_quant: str = "") -> "PagedKVCache":
         shape = (
             config.n_layers, num_pages, page_size,
             config.n_kv_heads * config.head_dim,
         )
+        if kv_quant:
+            if kv_quant != "int8":
+                raise ValueError(f"unknown kv_quant mode {kv_quant!r} (supported: 'int8')")
+            sshape = (config.n_layers, num_pages, scale_rows(config.n_kv_heads), page_size)
+            return cls(
+                k_pages=jnp.zeros(shape, jnp.int8),
+                v_pages=jnp.zeros(shape, jnp.int8),
+                k_scales=jnp.zeros(sshape, jnp.float32),
+                v_scales=jnp.zeros(sshape, jnp.float32),
+                page_size=page_size, num_pages=num_pages, kv_quant=kv_quant,
+            )
         return cls(
             k_pages=jnp.zeros(shape, config.dtype),
             v_pages=jnp.zeros(shape, config.dtype),
-            page_size=page_size,
-            num_pages=num_pages,
+            k_scales=jnp.zeros((1, 1, 1, 1), jnp.float32),
+            v_scales=jnp.zeros((1, 1, 1, 1), jnp.float32),
+            page_size=page_size, num_pages=num_pages,
         )
 
-    def layers_pytree(self) -> tuple[Any, Any]:
-        """The (k, v) pair carried through the model forward as the cache."""
-        return (self.k_pages, self.v_pages)
+    def layers_pytree(self) -> tuple[Any, Any, Any, Any]:
+        """The (k, v, k_scales, v_scales) tuple carried through the model
+        forward as the cache (scales are placeholders when kv_quant is
+        off — the attention callbacks always unpack four)."""
+        return (self.k_pages, self.v_pages, self.k_scales, self.v_scales)
 
     def hbm_bytes(self) -> int:
-        return self.k_pages.nbytes + self.v_pages.nbytes
+        return (self.k_pages.nbytes + self.v_pages.nbytes
+                + self.k_scales.nbytes + self.v_scales.nbytes)
 
 
 class PageAllocationError(RuntimeError):
@@ -186,6 +220,101 @@ def scatter_kv_chunk(
     k_pages = k_pages.at[lay, flat_phys, flat_off].set(k_flat, mode="drop")
     v_pages = v_pages.at[lay, flat_phys, flat_off].set(v_flat, mode="drop")
     return k_pages, v_pages
+
+
+def quantize_kv_rows(x: Any, n_kv: int) -> tuple[Any, Any]:
+    """Per-token-per-head symmetric int8 quantization of KV rows.
+
+    ``x``: [..., Hkv*hd] float — returns (q8 [..., Hkv*hd] int8,
+    scales [..., Hkv] fp32) with scale = amax over the head's channels /
+    127 (1.0 for all-zero rows so dequant is exact).
+    """
+    lead = x.shape[:-1]
+    hd = x.shape[-1] // n_kv
+    xh = x.reshape(*lead, n_kv, hd).astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xh), axis=-1)  # [..., Hkv]
+    scales = jnp.where(amax > 0, amax, 1.0) / 127.0
+    q = jnp.clip(jnp.round(xh / scales[..., None]), -127, 127).astype(jnp.int8)
+    return q.reshape(*lead, n_kv * hd), scales
+
+
+def scatter_kv_chunk_q8(
+    k_pages: Any,  # [L, P, page_size, Hkv*hd] int8
+    v_pages: Any,
+    k_scales: Any,  # [L, P, scale_rows, page_size] fp32
+    v_scales: Any,
+    k_new: Any,  # [B, C, Hkv, hd] float
+    v_new: Any,
+    page_table: Any,  # [B, max_pages]
+    start_pos: Any,  # [B]
+    n_valid: Any,  # [B]
+    page_size: int,
+    layer: Any,
+    n_kv: int,
+) -> tuple[Any, Any, Any, Any]:
+    """Quantizing variant of ``scatter_kv_chunk``: int8 rows into the data
+    pages, per-token-per-head scales into the scale pages. Same trash-page
+    redirection; scale writes for trash lanes land in the trash page's
+    scale block."""
+    B, C = k_new.shape[:2]
+    hd_fused = k_pages.shape[-1]
+    i = jnp.arange(C)[None, :]
+    pos = start_pos[:, None] + i
+    logical = pos // page_size
+    offset = pos % page_size
+    phys = jnp.take_along_axis(page_table, logical, axis=1)
+    valid = i < n_valid[:, None]
+    phys = jnp.where(valid, phys, TRASH_PAGE)
+
+    k_q, k_s = quantize_kv_rows(k_new.reshape(B, C, hd_fused), n_kv)
+    v_q, v_s = quantize_kv_rows(v_new.reshape(B, C, hd_fused), n_kv)
+
+    lay = jnp.broadcast_to(jnp.asarray(layer, jnp.int32), (B * C,))
+    flat_phys = phys.reshape(-1)
+    flat_off = offset.reshape(-1)
+    k_pages = k_pages.at[lay, flat_phys, flat_off].set(
+        k_q.reshape(B * C, hd_fused), mode="drop")
+    v_pages = v_pages.at[lay, flat_phys, flat_off].set(
+        v_q.reshape(B * C, hd_fused), mode="drop")
+    # scale layout is [.., head_row, token_col]: ONE combined scatter per
+    # array (a broadcast head-index column) — per-head scatters would each
+    # rebuild the full scale buffer (the usual XLA scatter copy)
+    heads = jnp.arange(n_kv)[None, :]  # [1, Hkv]
+    k_scales = k_scales.at[lay[:, None], flat_phys[:, None], heads, flat_off[:, None]].set(
+        k_s.reshape(-1, n_kv), mode="drop")
+    v_scales = v_scales.at[lay[:, None], flat_phys[:, None], heads, flat_off[:, None]].set(
+        v_s.reshape(-1, n_kv), mode="drop")
+    return k_pages, v_pages, k_scales, v_scales
+
+
+def gather_kv_q8(
+    k_pages: Any,  # [L, P, page_size, Hkv*hd] int8
+    v_pages: Any,
+    k_scales: Any,  # [L, P, scale_rows, page_size] fp32
+    v_scales: Any,
+    page_table: Any,  # [B, max_pages]
+    page_size: int,
+    layer: Any,
+    n_kv: int,
+    dtype: Any = jnp.bfloat16,
+) -> tuple[Any, Any]:
+    """Dequantizing variant of ``gather_kv`` (the jnp reference path for
+    the int8 cache): returns dense [B, max_len, Hkv, hd] in ``dtype``."""
+    B, max_pages = page_table.shape
+
+    def deq(pages, scales):
+        p_l = jax.lax.dynamic_index_in_dim(pages, layer, 0, keepdims=False)
+        s_l = jax.lax.dynamic_index_in_dim(scales, layer, 0, keepdims=False)
+        x = p_l[page_table]  # [B, MP, PS, Hkv*hd] int8
+        s = s_l[page_table]  # [B, MP, SPAD, PS] fp32
+        PS = x.shape[2]
+        hd = x.shape[-1] // n_kv
+        xh = x.reshape(B, max_pages, PS, n_kv, hd).astype(jnp.float32)
+        s_t = s[:, :, :n_kv, :].transpose(0, 1, 3, 2)  # [B, MP, PS, Hkv]
+        out = (xh * s_t[..., None]).astype(dtype)
+        return out.reshape(B, max_pages * PS, n_kv, hd)
+
+    return deq(k_pages, k_scales), deq(v_pages, v_scales)
 
 
 def gather_kv(

@@ -42,7 +42,7 @@ def attention_backend() -> str:
 
 def paged_attention(
     q: Array,  # [B, C, H, D]
-    k_pages: Array,  # [L, P, page_size, Hkv*D] — full-depth cache
+    k_pages: Array,  # [L, P, page_size, Hkv*D] — full-depth cache (or int8)
     v_pages: Array,
     page_table: Array,  # [B, max_pages]
     q_offset: Array,  # [B]
@@ -52,26 +52,47 @@ def paged_attention(
     page_size: int,
     n_kv: int,
     backend: str | None = None,
+    k_scales: Array | None = None,  # int8 cache: [L, P, SPAD, page_size] fp32
+    v_scales: Array | None = None,
 ) -> Array:
-    """Paged-KV attention via the requested (or default) backend."""
+    """Paged-KV attention via the requested (or default) backend. An int8
+    cache (engine kv_quant) is detected from the page dtype; the scale
+    arrays must then be provided."""
     backend = backend or attention_backend()
+    quantized = k_pages.dtype == jnp.int8
+    if quantized:
+        assert k_scales is not None and v_scales is not None
     if backend == "ref":
-        from finchat_tpu.engine.kv_cache import gather_kv
+        from finchat_tpu.engine.kv_cache import gather_kv, gather_kv_q8
         from finchat_tpu.ops.refs import mha_reference
 
-        k_all, v_all = gather_kv(
-            k_pages, v_pages, page_table, page_size,
-            jnp.asarray(layer, jnp.int32).reshape(()), n_kv,
-        )
+        lay = jnp.asarray(layer, jnp.int32).reshape(())
+        if quantized:
+            k_all, v_all = gather_kv_q8(
+                k_pages, v_pages, k_scales, v_scales, page_table, page_size,
+                lay, n_kv, dtype=q.dtype,
+            )
+        else:
+            k_all, v_all = gather_kv(
+                k_pages, v_pages, page_table, page_size, lay, n_kv,
+            )
         return mha_reference(
             q, k_all, v_all, causal=True, q_offset=q_offset, kv_len=kv_len
+        )
+    interpret = backend == "pallas-interpret"
+    if quantized:
+        from finchat_tpu.ops.paged_attention import paged_flash_attention_q8
+
+        return paged_flash_attention_q8(
+            q, k_pages, v_pages, k_scales, v_scales, page_table,
+            q_offset, kv_len, layer,
+            page_size=page_size, n_kv=n_kv, interpret=interpret,
         )
     from finchat_tpu.ops.paged_attention import paged_flash_attention
 
     return paged_flash_attention(
         q, k_pages, v_pages, page_table, q_offset, kv_len, layer,
-        page_size=page_size, n_kv=n_kv,
-        interpret=(backend == "pallas-interpret"),
+        page_size=page_size, n_kv=n_kv, interpret=interpret,
     )
 
 

@@ -95,6 +95,11 @@ def decode_state_shardings(mesh: Mesh, n_kv_heads: int | None = None) -> dict[st
         # keeping cache writes local)
         "k_pages": kv_spec,
         "v_pages": kv_spec,
+        # int8-KV scale arrays: (1,1,1,1) placeholders whenever a mesh is
+        # in play (kv_quant is single-chip only) — replicated so every
+        # DecodeState leaf still gets an explicit placement
+        "k_scales": ns(None, None, None, None),
+        "v_scales": ns(None, None, None, None),
         "page_table": ns(None, None),
         "context_lens": ns(None),
         "last_tokens": ns(None),

@@ -178,6 +178,11 @@ class EngineConfig:
     # prompt-lookup (engine/spec.py); 0 = off. Greedy-exact — RAG answers
     # quote retrieved rows, so drafts hit often on the product workload.
     spec_tokens: int = 0
+    # int8 paged-KV cache (kv_cache.py): halves decode-side KV HBM traffic
+    # and cache footprint via per-token-per-head scales; "" = model dtype.
+    # Single-chip serving only for now (disabled with a warning under a
+    # mesh).
+    kv_quant: str = ""
     # sequence-parallel mode for the seq-sharded long-prompt serving
     # prefill (SURVEY §5.7c/d): "ring" (K/V blocks rotate the ICI ring;
     # works for any head count, S beyond one chip's HBM) or "ulysses"
@@ -277,6 +282,7 @@ def load_config(
     )
     cfg.engine.spec_tokens = _env_int("FINCHAT_SPEC_TOKENS", cfg.engine.spec_tokens)
     cfg.engine.sp_mode = _env("FINCHAT_SP_MODE", cfg.engine.sp_mode)
+    cfg.engine.kv_quant = _env("FINCHAT_KV_QUANT", cfg.engine.kv_quant)
     cfg.serve.port = _env_int("FINCHAT_PORT", cfg.serve.port)
 
     # --- optional JSON config file ---
