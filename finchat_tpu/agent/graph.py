@@ -124,10 +124,32 @@ class LLMAgent:
         return graph
 
     # --- prompt assembly -------------------------------------------------
+    def _tool_system(self) -> str:
+        return f"The current date is {self.today()}.\n{self.tool_prompt}"
+
+    def _response_system(self) -> str:
+        return f"The current date is {self.today()}.\n\n{self.system_prompt}"
+
+    def prompt_heads(self) -> list[str]:
+        """The constant leading strings of every rendered prompt, one per
+        LLM role: ``render_chat_head`` over the SAME system builders the
+        prompt assembly below uses, so they are byte-for-byte prefixes of
+        the rendered prompts by construction (asserted in
+        tests/test_prefix_cache.py). The serving layer registers these
+        with the scheduler's shared-prefix KV cache and re-registers when
+        they change (the embedded date rolls over at midnight)."""
+        from finchat_tpu.models.tokenizer import render_chat_head
+
+        return [
+            render_chat_head(self._tool_system()),
+            render_chat_head(self._response_system()),
+        ]
+
     def _tool_prompt_text(self, state: AgentState) -> str:
         def build(s: AgentState) -> str:
-            system = f"The current date is {self.today()}.\n{self.tool_prompt}"
-            return render_chat(system, s.user_context, s.chat_history, s.user_query)
+            return render_chat(
+                self._tool_system(), s.user_context, s.chat_history, s.user_query
+            )
 
         return self._fit_prompt(build, state, self.tool_generator, self.tool_sampling)
 
@@ -136,8 +158,9 @@ class LLMAgent:
             context = f"{s.user_context}\n"
             if s.retrieved_transactions:
                 context += "Retrieved Transaction Data:\n" + "\n".join(s.retrieved_transactions)
-            system = f"The current date is {self.today()}.\n\n{self.system_prompt}"
-            return render_chat(system, context, s.chat_history, s.user_query)
+            return render_chat(
+                self._response_system(), context, s.chat_history, s.user_query
+            )
 
         return self._fit_prompt(build, state, self.response_generator, self.response_sampling)
 

@@ -566,6 +566,18 @@ class InferenceEngine:
             page_table=self.state.page_table.at[jnp.asarray(idx)].set(jnp.asarray(mat)),
         )
 
+    def set_context_lens_rows(self, rows: dict[int, int]) -> None:
+        """Set several slots' context lengths in ONE device update — used by
+        prefix-cache admission to start a slot at the shared prefix length
+        (see set_page_table_rows for why batching matters)."""
+        import numpy as np
+
+        idx = jnp.asarray(np.asarray(list(rows), np.int32))
+        vals = jnp.asarray(np.asarray(list(rows.values()), np.int32))
+        self.state = dataclasses.replace(
+            self.state, context_lens=self.state.context_lens.at[idx].set(vals)
+        )
+
     def set_last_token(self, slot: int, token: int) -> None:
         """Override a slot's next decode input — used by grammar-constrained
         sampling after a host-side pick replaces the device-sampled token."""

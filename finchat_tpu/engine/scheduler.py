@@ -78,6 +78,9 @@ class SequenceHandle:
     # created lazily by the spec decode path and kept in sync by _deliver —
     # proposing must be O(1) on the event loop, not a history rescan
     ngram_index: object | None = None
+    # shared-prefix cache entry this sequence's page table references
+    # (scheduler _PrefixEntry); refcounted so retirement can free safely
+    prefix_entry: object | None = None
     submitted_at: float = field(default_factory=time.perf_counter)
     first_token_at: float | None = None
     finished: bool = False
@@ -107,6 +110,21 @@ class _InFlightStep:
     constrained_slots: list[int]
 
 
+@dataclass
+class _PrefixEntry:
+    """One registered shared prompt head: its token ids, the pages holding
+    its prefilled KV, and a live-reference count so retirement (e.g. the
+    date inside the head rolled over) frees the pages only once no
+    in-flight sequence's page table still points at them."""
+
+    ids: list[int]
+    pages: list[int]
+    shared_len: int
+    owner: str
+    refs: int = 0
+    retired: bool = False
+
+
 class ContinuousBatchingScheduler:
     def __init__(self, engine: InferenceEngine, eos_id: int):
         self.engine = engine
@@ -130,6 +148,12 @@ class ContinuousBatchingScheduler:
         # drafting needs the previous token on the HOST, which depth-2
         # pipelining by construction has not fetched yet
         self.spec_k = cfg.spec_tokens
+        # shared-prefix KV cache: matched at admission so identical prompt
+        # heads (the constant system prompt every conversation shares) are
+        # prefilled ONCE per process instead of per request — see
+        # register_prefix / retire_prefixes
+        self._prefixes: list[_PrefixEntry] = []
+        self._n_prefixes_ever = 0  # unique allocator owner ids
 
     # --- public API -----------------------------------------------------
     async def start(self) -> None:
@@ -176,6 +200,79 @@ class ContinuousBatchingScheduler:
         self._wakeup.set()
         return handle
 
+    def register_prefix(self, prompt_ids: list[int]) -> int:
+        """Prefill a shared prompt head ONCE and serve its KV to every
+        later request that starts with it (reference parity argument: the
+        system prompt — 1.3-4.5k byte tokens rendered per request,
+        ``llm_agent.py:14-17`` — is identical for every conversation, so
+        re-prefilling it per request is pure waste; this is what makes the
+        TTFT target reachable under prompt-heavy RAG traffic).
+
+        Shares whole pages only (a partially-filled page would be written
+        by the owning sequence's appends); the remainder re-prefills per
+        request. Returns the shared token length (0 = nothing registered).
+        Call while the engine is idle (startup) or when a slot is free.
+        """
+        page = self.engine.page_size
+        n_pages = min(len(prompt_ids) // page, self.engine.max_pages_per_seq)
+        if n_pages <= 0:
+            return 0
+        shared_len = n_pages * page
+        ids = list(prompt_ids[:shared_len])
+        for entry in self._prefixes:
+            if not entry.retired and entry.shared_len == shared_len and entry.ids == ids:
+                return shared_len  # already registered
+        if not self.allocator.can_allocate(n_pages) or not self.free_slots:
+            logger.warning("prefix cache: no pages/slot free; not registering")
+            return 0
+        owner = f"__prefix_{self._n_prefixes_ever}__"
+        self._n_prefixes_ever += 1
+        pages = self.allocator.allocate(owner, n_pages)
+        slot = self.free_slots.pop()
+        try:
+            self.engine.set_page_table_row(slot, pages)
+            self.engine.prefill(slot, ids)  # fills exactly the shared pages
+        except Exception:
+            self.allocator.free(owner, pages)
+            raise
+        finally:
+            self.engine.reset_slot(slot)
+            self.free_slots.append(slot)
+        self._prefixes.append(_PrefixEntry(ids, pages, shared_len, owner))
+        logger.info("prefix cache: registered %d shared tokens (%d pages)",
+                    shared_len, n_pages)
+        return shared_len
+
+    def retire_prefixes(self) -> None:
+        """Stop matching every registered prefix (the caller is about to
+        register fresh heads — e.g. the embedded date rolled over). Pages
+        free immediately when unreferenced, else when the last in-flight
+        sequence using them releases (_release)."""
+        for entry in self._prefixes:
+            entry.retired = True
+        self._reap_prefixes()
+
+    def _reap_prefixes(self) -> None:
+        for entry in list(self._prefixes):
+            if entry.retired and entry.refs == 0:
+                self.allocator.free(entry.owner, entry.pages)
+                self._prefixes.remove(entry)
+
+    def _match_prefix(self, prompt_ids: list[int]) -> tuple["_PrefixEntry | None", int]:
+        """Longest live registered prefix usable for this prompt: whole
+        shared pages only, and at least one prompt token must remain to
+        prefill (the commit needs real last-token logits)."""
+        page = self.engine.page_size
+        cap = ((len(prompt_ids) - 1) // page) * page
+        best: tuple[_PrefixEntry | None, int] = (None, 0)
+        for entry in self._prefixes:
+            if entry.retired:
+                continue
+            usable = min(entry.shared_len, cap)
+            if usable > best[1] and prompt_ids[:usable] == entry.ids[:usable]:
+                best = (entry, usable)
+        return best
+
     def cancel(self, handle: SequenceHandle) -> None:
         """Client went away (e.g. watchdog timeout): evict and free."""
         if handle.finished:
@@ -189,17 +286,38 @@ class ContinuousBatchingScheduler:
     # --- internals ------------------------------------------------------
     def _admit(self) -> None:
         admitted: dict[int, list[int]] = {}
+        ctx_rows: dict[int, int] = {}
         while self.pending and self.free_slots:
             handle = self.pending[0]
-            need = pages_needed(
+            total = pages_needed(
                 len(handle.prompt_ids) + handle.sampling.max_new_tokens, self.engine.page_size
             )
-            if need > self.engine.max_pages_per_seq or not self.allocator.can_allocate(need):
+            # prompts long enough for the seq-sharded ring prefill keep it:
+            # a prefix hit would force the chunked path (ring assumes
+            # position 0), trading away the activation-memory safety the
+            # ring path exists for
+            if self.engine._use_ring_prefill(len(handle.prompt_ids)):
+                entry, shared_len = None, 0
+            else:
+                entry, shared_len = self._match_prefix(handle.prompt_ids)
+            shared_pages = entry.pages[: shared_len // self.engine.page_size] if entry else []
+            need = total - len(shared_pages)
+            if total > self.engine.max_pages_per_seq or not self.allocator.can_allocate(need):
                 break  # head-of-line waits for pages
             self.pending.popleft()
             slot = self.free_slots.pop()
             pages = self.allocator.allocate(handle.seq_id, need)
-            admitted[slot] = pages
+            # shared prefix pages lead (logical pages 0..): the slot reads
+            # them read-only — its own writes all land at positions >=
+            # shared_len, i.e. in its own pages
+            admitted[slot] = shared_pages + pages
+            if entry:
+                entry.refs += 1
+                handle.prefix_entry = entry
+                ctx_rows[slot] = shared_len
+                handle.prefill_pos = shared_len
+                METRICS.inc("finchat_prefix_hits_total")
+                METRICS.inc("finchat_prefix_tokens_saved_total", shared_len)
             handle.slot = slot
             handle.span.mark("admitted")
             if handle.constraint is None:
@@ -217,6 +335,8 @@ class ContinuousBatchingScheduler:
             # ONE device update for the whole admission burst — per-slot
             # eager updates cost ~15 ms each on remote-tunnel backends
             self.engine.set_page_table_rows(admitted)
+            if ctx_rows:
+                self.engine.set_context_lens_rows(ctx_rows)
             METRICS.set_gauge("finchat_queue_depth", len(self.pending))
 
     def _finish(self, handle: SequenceHandle, reason: str) -> None:
@@ -241,6 +361,10 @@ class ContinuousBatchingScheduler:
             self._top_k[handle.slot] = 0
             self.free_slots.append(handle.slot)
             handle.slot = -1
+            if handle.prefix_entry is not None:
+                handle.prefix_entry.refs -= 1
+                handle.prefix_entry = None
+                self._reap_prefixes()
 
     def _evict(self, handle: SequenceHandle, reason: str, error: str | None = None) -> None:
         self._release(handle)

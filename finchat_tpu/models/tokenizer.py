@@ -148,6 +148,14 @@ class IncrementalDecoder:
 _ROLE_TAGS = {"system": "<|system|>", "user": "<|user|>", "assistant": "<|assistant|>"}
 
 
+def render_chat_head(system_prompt: str) -> str:
+    """The constant leading string of a rendered prompt for a given system
+    text — BY CONSTRUCTION a byte prefix of ``render_chat`` output (which
+    builds its first part from this), so the shared-prefix KV cache and
+    the prompt builders can never drift apart."""
+    return f"{_ROLE_TAGS['system']}\n{system_prompt}\n"
+
+
 def render_chat(
     system_prompt: str,
     context: str,
@@ -160,7 +168,7 @@ def render_chat(
     holding ``{system_prompt}\\n{context}``, then the chat history in order,
     then the new user turn, then the assistant tag left open for generation.
     """
-    parts = [f"{_ROLE_TAGS['system']}\n{system_prompt}\n{context}\n"]
+    parts = [f"{render_chat_head(system_prompt)}{context}\n"]
     for turn in history:
         role = "user" if turn.is_user else "assistant"
         parts.append(f"{_ROLE_TAGS[role]}\n{turn.message}\n")

@@ -70,6 +70,42 @@ def load_prompts() -> tuple[str, str]:
     return system_prompt, tool_prompt
 
 
+def register_prompt_prefixes(agent, scheduler, tokenizer) -> list[str]:
+    """Prefill each LLM role's constant system head once and share its KV
+    across requests (scheduler shared-prefix cache). The final encoded
+    token is dropped before registering: a subword tokenizer can merge
+    across the head/context string boundary, so the last head token is the
+    only one whose identity depends on what follows (the byte tokenizer is
+    trivially boundary-stable, but Mixtral serving uses HF BPE). Returns
+    the registered heads so the caller can detect when they change (the
+    embedded date rolls over at midnight — see App._refresh_prefix_cache).
+    """
+    heads = agent.prompt_heads()
+    for head in heads:
+        scheduler.register_prefix(tokenizer.encode(head, add_bos=True)[:-1])
+    return heads
+
+
+def _maybe_refresh_prefix_cache(app: "App") -> None:
+    """Re-register the shared prompt heads when they change (midnight date
+    rollover): retire the stale prefixes (pages free once the last
+    in-flight reference releases) and prefill the fresh heads. Runs inline
+    on the request path — a once-a-day engine prefill; holding the event
+    loop here also means no scheduler step interleaves with registration."""
+    if app.scheduler is None or not app._registered_heads:
+        return
+    heads = app.agent.prompt_heads()
+    if heads == app._registered_heads:
+        return
+    tokenizer = getattr(app.agent.tool_generator, "tokenizer", None)
+    if tokenizer is None:
+        return
+    logger.info("prompt heads changed (date rollover); refreshing prefix cache")
+    app.scheduler.retire_prefixes()
+    register_prompt_prefixes(app.agent, app.scheduler, tokenizer)
+    app._registered_heads = heads
+
+
 def build_generators(cfg: AppConfig) -> tuple[TextGenerator, TextGenerator, ContinuousBatchingScheduler | None, object]:
     """Construct (tool_generator, response_generator, scheduler, tokenizer).
 
@@ -153,6 +189,12 @@ class App:
         # processing, main.py:96/138)
         self._inflight: set[asyncio.Task] = set()
         self._conv_tails: dict[str, asyncio.Task] = {}
+        # shared-prefix cache freshness: the registered heads embed today's
+        # date, so they go stale at midnight — _refresh_prefix_cache
+        # compares and re-registers on the request paths
+        self._registered_heads: list[str] = (
+            agent.prompt_heads() if cfg.engine.prefix_cache and scheduler is not None else []
+        )
 
     # --- lifespan -------------------------------------------------------
     async def start(self, serve_http: bool = True) -> None:
@@ -217,6 +259,7 @@ class App:
     async def chat(self, request: Request) -> Response:
         """Batch REST path (the reference's commented POST /process_message,
         main.py:44-49): runs the compiled agent graph."""
+        _maybe_refresh_prefix_cache(self)
         payload = request.json()
         missing = [k for k in ("conversation_id", "message", "user_id") if k not in payload]
         if missing:
@@ -234,6 +277,7 @@ class App:
 
     async def chat_stream(self, request: Request) -> Response | StreamingResponse:
         """SSE stream of the full internal event protocol."""
+        _maybe_refresh_prefix_cache(self)
         payload = request.json()
         missing = [k for k in ("conversation_id", "message", "user_id") if k not in payload]
         if missing:
@@ -291,6 +335,7 @@ class App:
 
     # --- Kafka worker loop ----------------------------------------------
     async def process_message(self, message, message_value: dict | None = None) -> None:
+        _maybe_refresh_prefix_cache(self)
         if message_value is None:
             message_value = json.loads(message.value().decode("utf-8"))
         msg = message_value["message"]
@@ -539,6 +584,8 @@ def build_app(cfg: AppConfig | None = None, *, store: ConversationStore | None =
             top_k=cfg.engine.top_k, max_new_tokens=cfg.engine.max_new_tokens,
         ),
     )
+    if cfg.engine.prefix_cache and scheduler is not None and tokenizer is not None:
+        register_prompt_prefixes(agent, scheduler, tokenizer)
     app_retriever = retriever if isinstance(retriever, TransactionRetriever) else None
     return App(cfg, agent=agent, store=store, kafka=kafka, scheduler=scheduler,
                retriever=app_retriever)

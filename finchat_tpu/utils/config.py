@@ -178,6 +178,11 @@ class EngineConfig:
     # prompt-lookup (engine/spec.py); 0 = off. Greedy-exact — RAG answers
     # quote retrieved rows, so drafts hit often on the product workload.
     spec_tokens: int = 0
+    # shared-prefix KV cache: prefill each LLM role's constant system head
+    # once per process and share its pages across requests (scheduler
+    # register_prefix) — the dominant TTFT lever for the RAG workload,
+    # whose every prompt repeats the same 1-4.5k-token system prefix
+    prefix_cache: bool = True
     # int8 paged-KV cache (kv_cache.py): halves decode-side KV HBM traffic
     # and cache footprint via per-token-per-head scales; "" = model dtype.
     # Single-chip serving only for now (disabled with a warning under a
@@ -283,6 +288,7 @@ def load_config(
     cfg.engine.spec_tokens = _env_int("FINCHAT_SPEC_TOKENS", cfg.engine.spec_tokens)
     cfg.engine.sp_mode = _env("FINCHAT_SP_MODE", cfg.engine.sp_mode)
     cfg.engine.kv_quant = _env("FINCHAT_KV_QUANT", cfg.engine.kv_quant)
+    cfg.engine.prefix_cache = _env_bool("FINCHAT_PREFIX_CACHE", cfg.engine.prefix_cache)
     cfg.serve.port = _env_int("FINCHAT_PORT", cfg.serve.port)
 
     # --- optional JSON config file ---

@@ -1,0 +1,241 @@
+"""Shared-prefix KV cache: identical prompt heads (the constant system
+prompt) prefill once per process and share pages across requests.
+
+Contracts pinned here:
+- golden equality: a prefix-cached request streams the SAME tokens as an
+  uncached one (the shared KV is byte-identical to what the request would
+  have written itself);
+- resource accounting: cache hits allocate fewer pages and skip the shared
+  tokens' prefill; eviction never frees shared pages; allocator ownership
+  invariants hold through churn;
+- matching rules: whole pages only, at least one prompt token left to
+  prefill, non-matching prompts unaffected.
+"""
+
+import asyncio
+
+import jax
+import pytest
+
+from finchat_tpu.engine.engine import InferenceEngine
+from finchat_tpu.engine.generator import EngineGenerator
+from finchat_tpu.engine.sampler import SamplingParams
+from finchat_tpu.engine.scheduler import ContinuousBatchingScheduler
+from finchat_tpu.models.llama import PRESETS, init_params
+from finchat_tpu.models.tokenizer import ByteTokenizer
+from finchat_tpu.utils.config import EngineConfig
+
+CONFIG = PRESETS["tiny"]
+PAGE = 8
+
+
+def _make_scheduler(max_seqs=4):
+    tok = ByteTokenizer()
+    cfg = EngineConfig(
+        max_seqs=max_seqs, page_size=PAGE, num_pages=128, max_seq_len=128,
+        prefill_chunk=16,
+    )
+    params = init_params(CONFIG, jax.random.key(0))
+    engine = InferenceEngine(CONFIG, params, cfg)
+    return tok, ContinuousBatchingScheduler(engine, eos_id=tok.eos_id)
+
+
+HEAD = "system: you are a terse financial assistant, answer briefly."  # 58 chars
+
+
+async def _collect(scheduler, seq_id, prompt_ids, n_new):
+    handle = await scheduler.submit(
+        seq_id, prompt_ids, SamplingParams(temperature=0.0, max_new_tokens=n_new)
+    )
+    tokens = []
+    while True:
+        event = await asyncio.wait_for(handle.events.get(), timeout=120)
+        if event["type"] == "token":
+            tokens.append(event["token_id"])
+        elif event["type"] == "done":
+            return handle, tokens
+        else:
+            raise AssertionError(event)
+
+
+def test_register_shares_whole_pages_only():
+    tok, scheduler = _make_scheduler()
+    ids = tok.encode(HEAD, add_bos=True)
+    shared = scheduler.register_prefix(ids)
+    assert shared == (len(ids) // PAGE) * PAGE > 0
+    # registration is idempotent and holds its pages under a prefix owner
+    used_after = scheduler.allocator.used_count
+    assert scheduler.register_prefix(ids) == shared
+    assert scheduler.allocator.used_count == used_after
+    # too-short prefix registers nothing
+    assert scheduler.register_prefix([1, 2, 3]) == 0
+    # registration must leave the engine slot-state clean
+    import numpy as np
+
+    assert np.asarray(scheduler.engine.state.context_lens).sum() == 0
+    assert np.asarray(scheduler.engine.state.page_table).sum() == 0
+
+
+def test_prefix_hit_streams_identical_tokens_and_saves_pages():
+    tok = ByteTokenizer()
+    prompt = tok.encode(HEAD + " q: how much did I spend?", add_bos=True)
+    n_new = 10
+
+    async def run(register):
+        _, scheduler = _make_scheduler()
+        shared = scheduler.register_prefix(tok.encode(HEAD, add_bos=True)) if register else 0
+        base_used = scheduler.allocator.used_count
+        await scheduler.start()
+        try:
+            handle, tokens = await _collect(scheduler, "s", prompt, n_new)
+            return shared, base_used, handle, tokens, scheduler
+        finally:
+            await scheduler.stop()
+
+    shared, _, h_hit, hit_tokens, sched_hit = asyncio.run(run(True))
+    _, _, _, miss_tokens, _ = asyncio.run(run(False))
+    assert shared > 0
+    assert hit_tokens == miss_tokens  # golden equality
+    # the hit skipped the shared tokens' prefill
+    assert h_hit.prefill_pos >= shared
+    # after the stream finished, only the prefix pages remain allocated
+    sched_hit.allocator.check_invariants()
+    assert sched_hit.allocator.used_count == shared // PAGE
+
+
+def test_eviction_never_frees_shared_pages():
+    tok, scheduler = _make_scheduler(max_seqs=2)
+    ids = tok.encode(HEAD, add_bos=True)
+    shared = scheduler.register_prefix(ids)
+    prefix_pages = scheduler.allocator.used_count
+    prompt = ids + tok.encode(" extra question", add_bos=False)
+
+    async def run():
+        await scheduler.start()
+        try:
+            for i in range(3):  # churn: admit, finish, slot reuse
+                _, tokens = await _collect(scheduler, f"s{i}", prompt, 4)
+                assert len(tokens) == 4
+        finally:
+            await scheduler.stop()
+
+    asyncio.run(run())
+    scheduler.allocator.check_invariants()
+    assert scheduler.allocator.used_count == prefix_pages
+    assert shared > 0
+
+
+def test_non_matching_prompt_unaffected():
+    tok, scheduler = _make_scheduler()
+    scheduler.register_prefix(tok.encode(HEAD, add_bos=True))
+    other = tok.encode("completely different beginning, same engine", add_bos=True)
+
+    async def run():
+        await scheduler.start()
+        try:
+            handle, tokens = await _collect(scheduler, "other", other, 5)
+            return handle, tokens
+        finally:
+            await scheduler.stop()
+
+    handle, tokens = asyncio.run(run())
+    assert len(tokens) == 5
+    # no shared pages were attached: the full prompt was prefilled
+    assert handle.prefill_pos == len(other)
+
+
+def test_retire_frees_only_after_last_reference_releases():
+    """Date-rollover path: retired prefixes stop matching immediately but
+    their pages survive until no in-flight page table references them."""
+    tok, scheduler = _make_scheduler(max_seqs=2)
+    ids = tok.encode(HEAD, add_bos=True)
+    shared = scheduler.register_prefix(ids)
+    assert shared > 0
+    prefix_pages = shared // PAGE
+    prompt = ids + tok.encode(" and a question", add_bos=False)
+
+    async def run():
+        await scheduler.start()
+        try:
+            handle = await scheduler.submit(
+                "s", prompt, SamplingParams(temperature=0.0, max_new_tokens=24)
+            )
+            # wait for admission (prefix attached)
+            while handle.prefix_entry is None and not handle.finished:
+                await asyncio.sleep(0.005)
+            entry = handle.prefix_entry
+            assert entry is not None and entry.refs == 1
+            scheduler.retire_prefixes()
+            # still referenced: pages must NOT be freed yet
+            assert scheduler.allocator.used_count >= prefix_pages
+            assert scheduler._match_prefix(prompt) == (None, 0)  # stops matching
+            while True:
+                event = await asyncio.wait_for(handle.events.get(), timeout=120)
+                if event["type"] == "done":
+                    break
+            return entry
+        finally:
+            await scheduler.stop()
+
+    asyncio.run(run())
+    scheduler.allocator.check_invariants()
+    assert scheduler._prefixes == []  # reaped after release
+    assert scheduler.allocator.used_count == 0  # pages returned
+
+
+def test_agent_prompt_heads_are_rendered_prompt_prefixes():
+    """The byte-for-byte-prefix claim prompt_heads() makes (and the prefix
+    cache relies on) must hold against the actual prompt builders."""
+    from finchat_tpu.agent.graph import LLMAgent
+    from finchat_tpu.agent.state import AgentState
+    from finchat_tpu.engine.generator import StubGenerator
+
+    stub = StubGenerator(default="x")
+    agent = LLMAgent(stub, stub, None, "SYSTEM RULES", "TOOL RULES")
+    state = AgentState(
+        user_query="how much did I spend?", user_id="u", user_context="name: Pat",
+    )
+    tool_head, resp_head = agent.prompt_heads()
+    assert agent._tool_prompt_text(state).startswith(tool_head)
+    state.retrieved_transactions = ["row1", "row2"]
+    assert agent._response_prompt_text(state).startswith(resp_head)
+
+
+def test_ring_eligible_prompts_skip_prefix_match():
+    """Long prompts that would take the seq-sharded ring prefill keep it:
+    admission must not attach a prefix (which would force the chunked
+    path, trading away the ring's activation-memory safety)."""
+    from finchat_tpu.engine.scheduler import SequenceHandle
+
+    tok, scheduler = _make_scheduler()
+    ids = tok.encode(HEAD, add_bos=True)
+    assert scheduler.register_prefix(ids) > 0
+    prompt = ids + [7, 8, 9]
+
+    def admit(ring_eligible):
+        scheduler.engine._use_ring_prefill = lambda n: ring_eligible
+        handle = SequenceHandle(
+            seq_id=f"s{ring_eligible}", prompt_ids=prompt,
+            sampling=SamplingParams(temperature=0.0, max_new_tokens=4),
+        )
+        scheduler.pending.append(handle)
+        scheduler._admit()
+        assert handle.slot >= 0
+        return handle
+
+    ring = admit(True)
+    assert ring.prefix_entry is None and ring.prefill_pos == 0
+    chunked = admit(False)
+    assert chunked.prefix_entry is not None and chunked.prefill_pos > 0
+
+
+def test_match_leaves_at_least_one_token_to_prefill():
+    tok, scheduler = _make_scheduler()
+    ids = tok.encode(HEAD, add_bos=True)
+    shared = scheduler.register_prefix(ids)
+    # a prompt that IS exactly the registered shared head: matching must
+    # cap below the prompt length so the last token still prefills
+    exact = ids[:shared]
+    entry, used = scheduler._match_prefix(exact)
+    assert used <= len(exact) - 1
+    assert used % PAGE == 0 and entry is not None
