@@ -37,7 +37,7 @@ BASELINE_TTFT_P50_S = 0.300  # BASELINE.md: p50 TTFT <= 300 ms
 
 async def run_load(
     preset: str, sessions: int, prompt_len: int, new_tokens: int,
-    page_size: int, prefill_chunk: int,
+    page_size: int, prefill_chunk: int, shared_prefix: int = 0,
 ) -> dict:
     from finchat_tpu.engine.engine import InferenceEngine
     from finchat_tpu.engine.generator import EngineGenerator
@@ -68,8 +68,24 @@ async def run_load(
     gen = EngineGenerator(scheduler, tok)
 
     rng = np.random.default_rng(0)
+    # --shared-prefix N: every session's prompt opens with the SAME N
+    # characters (the system-prompt shape of the real workload) and the
+    # head is registered with the scheduler's shared-prefix KV cache —
+    # measuring the TTFT the product path actually sees (serve/app.py
+    # registers the agent's prompt heads the same way)
+    head = ""
+    registered_tokens = 0
+    if shared_prefix > 0:
+        head = "".join(chr(int(c)) for c in rng.integers(97, 122, size=shared_prefix))
+        registered_tokens = scheduler.register_prefix(tok.encode(head, add_bos=True)[:-1])
+        if registered_tokens == 0:
+            # whole pages only: a head shorter than one page registers
+            # nothing — fail loudly instead of mislabeling an uncached run
+            print(f"[load] shared prefix of {shared_prefix} chars registered 0 "
+                  f"tokens (page_size {page_size} too large?)", file=sys.stderr)
+    tail_len = max(prompt_len - shared_prefix, 1)
     prompts = [
-        "".join(chr(int(c)) for c in rng.integers(97, 122, size=prompt_len))
+        head + "".join(chr(int(c)) for c in rng.integers(97, 122, size=tail_len))
         for _ in range(sessions)
     ]
     sampling = SamplingParams(temperature=0.5, max_new_tokens=new_tokens)
@@ -114,6 +130,9 @@ async def run_load(
         "total_tokens": total_tokens,
         "wall_s": round(wall, 2),
         "warmup_s": round(warmup_s, 1),
+        # the ACTUAL shared length register_prefix accepted (whole pages
+        # only; 0 = the cache never engaged, whatever --shared-prefix said)
+        "shared_prefix_tokens": registered_tokens,
         "model": preset,
         "platform": jax.devices()[0].platform,
     }
@@ -137,11 +156,14 @@ def main() -> None:
     p.add_argument("--new-tokens", type=int, default=64 if on_tpu else 16)
     p.add_argument("--page-size", type=int, default=128)
     p.add_argument("--prefill-chunk", type=int, default=128)
+    p.add_argument("--shared-prefix", type=int, default=0,
+                   help="chars of common prompt head registered with the "
+                        "shared-prefix KV cache (the system-prompt shape)")
     args = p.parse_args()
     result = asyncio.run(
         run_load(
             args.preset, args.sessions, args.prompt_len, args.new_tokens,
-            args.page_size, args.prefill_chunk,
+            args.page_size, args.prefill_chunk, args.shared_prefix,
         )
     )
     print(json.dumps(result))

@@ -81,9 +81,10 @@ def register_prompt_prefixes(agent, scheduler, tokenizer) -> list[str]:
     embedded date rolls over at midnight — see App._refresh_prefix_cache).
     """
     heads = agent.prompt_heads()
+    ok = True
     for head in heads:
-        scheduler.register_prefix(tokenizer.encode(head, add_bos=True)[:-1])
-    return heads
+        ok &= scheduler.register_prefix(tokenizer.encode(head, add_bos=True)[:-1]) > 0
+    return heads if ok else []
 
 
 def _maybe_refresh_prefix_cache(app: "App") -> None:
@@ -92,7 +93,7 @@ def _maybe_refresh_prefix_cache(app: "App") -> None:
     in-flight reference releases) and prefill the fresh heads. Runs inline
     on the request path — a once-a-day engine prefill; holding the event
     loop here also means no scheduler step interleaves with registration."""
-    if app.scheduler is None or not app._registered_heads:
+    if not app._prefix_cache_enabled or app.scheduler is None:
         return
     heads = app.agent.prompt_heads()
     if heads == app._registered_heads:
@@ -102,8 +103,10 @@ def _maybe_refresh_prefix_cache(app: "App") -> None:
         return
     logger.info("prompt heads changed (date rollover); refreshing prefix cache")
     app.scheduler.retire_prefixes()
-    register_prompt_prefixes(app.agent, app.scheduler, tokenizer)
-    app._registered_heads = heads
+    # store what actually REGISTERED ([] on failure — e.g. no free slot
+    # under full load), so the next request retries instead of silently
+    # serving uncached all day
+    app._registered_heads = register_prompt_prefixes(app.agent, app.scheduler, tokenizer)
 
 
 def build_generators(cfg: AppConfig) -> tuple[TextGenerator, TextGenerator, ContinuousBatchingScheduler | None, object]:
@@ -190,11 +193,11 @@ class App:
         self._inflight: set[asyncio.Task] = set()
         self._conv_tails: dict[str, asyncio.Task] = {}
         # shared-prefix cache freshness: the registered heads embed today's
-        # date, so they go stale at midnight — _refresh_prefix_cache
-        # compares and re-registers on the request paths
-        self._registered_heads: list[str] = (
-            agent.prompt_heads() if cfg.engine.prefix_cache and scheduler is not None else []
-        )
+        # date, so they go stale at midnight — _maybe_refresh_prefix_cache
+        # compares and re-registers on the request paths. build_app fills
+        # _registered_heads with what actually registered.
+        self._prefix_cache_enabled = cfg.engine.prefix_cache and scheduler is not None
+        self._registered_heads: list[str] = []
 
     # --- lifespan -------------------------------------------------------
     async def start(self, serve_http: bool = True) -> None:
@@ -584,8 +587,9 @@ def build_app(cfg: AppConfig | None = None, *, store: ConversationStore | None =
             top_k=cfg.engine.top_k, max_new_tokens=cfg.engine.max_new_tokens,
         ),
     )
-    if cfg.engine.prefix_cache and scheduler is not None and tokenizer is not None:
-        register_prompt_prefixes(agent, scheduler, tokenizer)
     app_retriever = retriever if isinstance(retriever, TransactionRetriever) else None
-    return App(cfg, agent=agent, store=store, kafka=kafka, scheduler=scheduler,
-               retriever=app_retriever)
+    app = App(cfg, agent=agent, store=store, kafka=kafka, scheduler=scheduler,
+              retriever=app_retriever)
+    if app._prefix_cache_enabled and tokenizer is not None:
+        app._registered_heads = register_prompt_prefixes(agent, scheduler, tokenizer)
+    return app
