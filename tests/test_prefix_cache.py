@@ -229,6 +229,41 @@ def test_ring_eligible_prompts_skip_prefix_match():
     assert chunked.prefix_entry is not None and chunked.prefill_pos > 0
 
 
+def test_prefix_cache_composes_with_speculative_decoding():
+    """Both round-4 serving features on at once: a prefix-cached greedy
+    request decoding through verify steps must stream exactly what the
+    plain (no prefix, no spec) scheduler streams."""
+    import dataclasses as dc
+
+    tok = ByteTokenizer()
+    prompt = tok.encode(HEAD + " abcabcabc", add_bos=True)
+    n_new = 12
+
+    async def run(spec_tokens, register):
+        cfg = EngineConfig(
+            max_seqs=4, page_size=PAGE, num_pages=128, max_seq_len=128,
+            prefill_chunk=16, spec_tokens=spec_tokens,
+        )
+        params = init_params(CONFIG, jax.random.key(0))
+        scheduler = ContinuousBatchingScheduler(
+            InferenceEngine(CONFIG, params, cfg), eos_id=tok.eos_id
+        )
+        if register:
+            assert scheduler.register_prefix(tok.encode(HEAD, add_bos=True)) > 0
+        await scheduler.start()
+        try:
+            handle, tokens = await _collect(scheduler, "s", prompt, n_new)
+            if register:
+                assert handle.prefill_pos >= PAGE  # the hit engaged
+            return tokens
+        finally:
+            await scheduler.stop()
+
+    plain = asyncio.run(run(0, False))
+    both = asyncio.run(run(3, True))
+    assert both == plain and len(plain) >= 1  # (this prompt EOSes early)
+
+
 def test_match_leaves_at_least_one_token_to_prefill():
     tok, scheduler = _make_scheduler()
     ids = tok.encode(HEAD, add_bos=True)
