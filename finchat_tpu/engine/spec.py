@@ -32,13 +32,17 @@ class NgramIndex:
     """
 
     def __init__(self, history: list[int] | None = None, *,
-                 ngram: int = 3, min_ngram: int = 2):
+                 ngram: int = 3, min_ngram: int = 2, max_history: int = 4096):
         assert 1 <= min_ngram <= ngram
         self._ns = tuple(range(ngram, min_ngram - 1, -1))  # longest first
         self._h: list[int] = []
         self._latest: dict[tuple, int] = {}
         self._prev: dict[tuple, int] = {}
-        for tok in history or []:
+        # cap the initial build: indexing a 32k-token ring-prefilled prompt
+        # would do ~2 dict inserts per token ON THE EVENT LOOP (the
+        # scheduler builds lazily at the first spec step); matches the
+        # one-shot wrapper's cap below
+        for tok in (history or [])[-max_history:]:
             self.push(tok)
 
     def push(self, token: int) -> None:
@@ -85,4 +89,6 @@ def propose_ngram_drafts(
     live sequence keep a persistent index instead — see the scheduler)."""
     if k <= 0:
         return []
-    return NgramIndex(history[-max_history:], ngram=ngram, min_ngram=min_ngram).propose(k)
+    return NgramIndex(
+        history, ngram=ngram, min_ngram=min_ngram, max_history=max_history
+    ).propose(k)

@@ -70,21 +70,22 @@ def load_prompts() -> tuple[str, str]:
     return system_prompt, tool_prompt
 
 
-def register_prompt_prefixes(agent, scheduler, tokenizer) -> list[str]:
+def register_prompt_prefixes(agent, scheduler, tokenizer) -> set[str]:
     """Prefill each LLM role's constant system head once and share its KV
     across requests (scheduler shared-prefix cache). The final encoded
     token is dropped before registering: a subword tokenizer can merge
     across the head/context string boundary, so the last head token is the
     only one whose identity depends on what follows (the byte tokenizer is
     trivially boundary-stable, but Mixtral serving uses HF BPE). Returns
-    the registered heads so the caller can detect when they change (the
-    embedded date rolls over at midnight — see App._refresh_prefix_cache).
+    the SUCCESSFULLY registered heads — per head, so one persistently
+    failing head (too short for a page, pages exhausted) cannot poison the
+    other's registration (see _maybe_refresh_prefix_cache).
     """
-    heads = agent.prompt_heads()
-    ok = True
-    for head in heads:
-        ok &= scheduler.register_prefix(tokenizer.encode(head, add_bos=True)[:-1]) > 0
-    return heads if ok else []
+    registered: set[str] = set()
+    for head in agent.prompt_heads():
+        if scheduler.register_prefix(tokenizer.encode(head, add_bos=True)[:-1]) > 0:
+            registered.add(head)
+    return registered
 
 
 def _maybe_refresh_prefix_cache(app: "App") -> None:
@@ -96,17 +97,22 @@ def _maybe_refresh_prefix_cache(app: "App") -> None:
     if not app._prefix_cache_enabled or app.scheduler is None:
         return
     heads = app.agent.prompt_heads()
-    if heads == app._registered_heads:
-        return
+    if all(h in app._registered_heads for h in heads):
+        return  # every current head is live
     tokenizer = getattr(app.agent.tool_generator, "tokenizer", None)
     if tokenizer is None:
         return
-    logger.info("prompt heads changed (date rollover); refreshing prefix cache")
-    app.scheduler.retire_prefixes()
-    # store what actually REGISTERED ([] on failure — e.g. no free slot
-    # under full load), so the next request retries instead of silently
-    # serving uncached all day
-    app._registered_heads = register_prompt_prefixes(app.agent, app.scheduler, tokenizer)
+    stale = [h for h in app._registered_heads if h not in heads]
+    if stale:
+        # date rollover: nothing previously registered can match anymore —
+        # retire (pages free as in-flight references release) and rebuild
+        logger.info("prompt heads changed (date rollover); refreshing prefix cache")
+        app.scheduler.retire_prefixes()
+        app._registered_heads = set()
+    # (re)try only the missing heads; register_prefix is idempotent and
+    # cheap on failure, so a persistently failing head retries without
+    # churning the successfully registered one
+    app._registered_heads |= register_prompt_prefixes(app.agent, app.scheduler, tokenizer)
 
 
 def build_generators(cfg: AppConfig) -> tuple[TextGenerator, TextGenerator, ContinuousBatchingScheduler | None, object]:
@@ -197,7 +203,7 @@ class App:
         # compares and re-registers on the request paths. build_app fills
         # _registered_heads with what actually registered.
         self._prefix_cache_enabled = cfg.engine.prefix_cache and scheduler is not None
-        self._registered_heads: list[str] = []
+        self._registered_heads: set[str] = set()
 
     # --- lifespan -------------------------------------------------------
     async def start(self, serve_http: bool = True) -> None:

@@ -264,6 +264,27 @@ def test_prefix_cache_composes_with_speculative_decoding():
     assert both == plain and len(plain) >= 1  # (this prompt EOSes early)
 
 
+def test_register_prompt_prefixes_partial_success():
+    """One unregistrable head (shorter than a page) must not poison the
+    other's registration, and the refresh path retries only the missing
+    one without retiring the good one (serve/app.py)."""
+    from finchat_tpu.serve.app import register_prompt_prefixes
+
+    tok, scheduler = _make_scheduler()
+
+    class FakeAgent:
+        def prompt_heads(self):
+            return [HEAD, "hi"]  # "hi" can never fill a page
+
+    registered = register_prompt_prefixes(FakeAgent(), scheduler, tok)
+    assert registered == {HEAD}
+    pages_used = scheduler.allocator.used_count
+    assert pages_used > 0
+    # idempotent retry: the good head is NOT re-prefilled into new pages
+    assert register_prompt_prefixes(FakeAgent(), scheduler, tok) == {HEAD}
+    assert scheduler.allocator.used_count == pages_used
+
+
 def test_match_leaves_at_least_one_token_to_prefill():
     tok, scheduler = _make_scheduler()
     ids = tok.encode(HEAD, add_bos=True)

@@ -303,6 +303,21 @@ def test_ngram_proposer():
     assert propose_ngram_drafts([1, 2], 0) == []
 
 
+def test_ngram_index_build_is_bounded():
+    """The scheduler builds the index lazily ON THE EVENT LOOP from the
+    full sequence history; the constructor must cap how much it indexes
+    (a 32k ring-prefilled prompt would otherwise stall every stream)."""
+    from finchat_tpu.engine.spec import NgramIndex
+
+    ancient = [1, 2, 3, 9, 9, 9]  # the only recurrence source
+    history = ancient + [int(c) for c in range(4, 9)] * 1000 + [1, 2, 3]
+    idx = NgramIndex(history, max_history=100)
+    assert len(idx._h) == 100  # only the tail was indexed
+    assert idx.propose(3) == []  # the ancient [1,2,3] match is outside the cap
+    # a cap covering the whole history finds it
+    assert NgramIndex(history, max_history=10_000).propose(3) == [9, 9, 9]
+
+
 def test_ngram_index_incremental_matches_oneshot():
     """Pushing token-by-token must propose exactly what a fresh index over
     the full history proposes (the scheduler keeps a live index; the
