@@ -215,7 +215,7 @@ def prefill_step(
 
 
 def _ring_prefill_attention_fn(mesh, page_table: Array, start_pos: Array, n_valid: Array,
-                               page_size: int, sp_mode: str = "ring"):
+                               page_size: int, n_kv: int, sp_mode: str = "ring"):
     """Attention callback for the seq-sharded long-prompt prefill: SP
     attention over the ``seq`` mesh axis for the compute — ring (K/V blocks
     rotate the ICI ring) or Ulysses (all-to-all head scatter, SURVEY
@@ -236,13 +236,18 @@ def _ring_prefill_attention_fn(mesh, page_table: Array, start_pos: Array, n_vali
             out = ring_attention(
                 q, k, v, mesh=mesh, axis="seq", head_axis="model", causal=True
             )
-        # NOTE: no int8 write branch here on purpose — SP prefill requires
-        # a mesh and the engine disables kv_quant under a mesh (single-chip
-        # only for now), so an int8 cache can never reach this path
-        k_pages, v_pages = scatter_kv_chunk(
-            k_pages, v_pages, k, v, page_table, start_pos, n_valid,
-            page_size, layer_idx,
-        )
+        if k_pages.dtype == jnp.int8:
+            from finchat_tpu.engine.kv_cache import scatter_kv_chunk_q8
+
+            k_pages, v_pages, k_scales, v_scales = scatter_kv_chunk_q8(
+                k_pages, v_pages, k_scales, v_scales, k, v,
+                page_table, start_pos, n_valid, page_size, layer_idx, n_kv,
+            )
+        else:
+            k_pages, v_pages = scatter_kv_chunk(
+                k_pages, v_pages, k, v, page_table, start_pos, n_valid,
+                page_size, layer_idx,
+            )
         return out, (k_pages, v_pages, k_scales, v_scales)
 
     return attention
@@ -276,7 +281,7 @@ def ring_prefill_step(
 
     attention = _ring_prefill_attention_fn(
         mesh, page_row, jnp.zeros((1,), jnp.int32), n_valid[None], page_size,
-        sp_mode,
+        config.n_kv_heads, sp_mode,
     )
     # hidden states only — a full [S, vocab] fp32 logits tensor at long-S
     # would cost GBs in exactly the regime this path exists for; project
@@ -494,15 +499,11 @@ class InferenceEngine:
             -(-engine_cfg.max_seq_len // engine_cfg.page_size),
         )
         self.mesh = mesh
-        kv_quant = engine_cfg.kv_quant
-        if kv_quant and mesh is not None:
-            # the scale arrays' padded head-row dim has no TP sharding story
-            # yet (shard_decode_state shards KV pages over the fused head
-            # minor dim); single-chip serving is the target use case — the
-            # 16 GB v5e with int8 weights + int8 KV
-            logger.warning("kv_quant=%s is single-chip only for now; disabling under a mesh", kv_quant)
-            kv_quant = ""
-        self.kv_quant = kv_quant
+        # int8 KV composes with a mesh: pages shard over the fused KV-head
+        # minor dim, scales over their head row dim (decode_state_shardings;
+        # aligned blocks when Hkv % 8 == 0, replicated — they're ~6% of the
+        # pages — otherwise), and the SP-prefill write path quantizes too
+        self.kv_quant = kv_quant = engine_cfg.kv_quant
         state = create_state(config, engine_cfg, self.max_pages_per_seq, kv_quant=kv_quant)
         if mesh is not None:
             # TP placement: params sharded Megatron-style, KV pages sharded

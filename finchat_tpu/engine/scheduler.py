@@ -111,6 +111,24 @@ class _InFlightStep:
 
 
 @dataclass
+class _PrefixJob:
+    """An in-progress chunked prefix registration (register_prefix_async):
+    the head prefills one chunk per prefill round, riding the same batched
+    ``prefill_step`` as admitted sequences, so decode steps interleave and
+    a midnight refresh never stalls in-flight streams for the whole head
+    (VERDICT r4 weak #6). Owns its pages and an engine slot until it
+    completes (entry published) or fails (pages freed, future gets 0)."""
+
+    ids: list[int]
+    shared_len: int
+    owner: str
+    pages: list[int]
+    slot: int
+    future: asyncio.Future
+    pos: int = 0
+
+
+@dataclass
 class _PrefixEntry:
     """One registered shared prompt head: its token ids, the pages holding
     its prefilled KV, and a live-reference count so retirement (e.g. the
@@ -126,6 +144,12 @@ class _PrefixEntry:
 
 
 class ContinuousBatchingScheduler:
+    # spec-decode all-miss demotion thresholds (see __init__ comment):
+    # demote after this many consecutive zero-accept verify steps...
+    SPEC_MISS_DEMOTE = 4
+    # ...and re-probe after this many pipelined steps
+    SPEC_RETRY_EVERY = 16
+
     def __init__(self, engine: InferenceEngine, eos_id: int):
         self.engine = engine
         self.eos_id = eos_id
@@ -148,12 +172,22 @@ class ContinuousBatchingScheduler:
         # drafting needs the previous token on the HOST, which depth-2
         # pipelining by construction has not fetched yet
         self.spec_k = cfg.spec_tokens
+        # all-miss demotion: depth-1 spec steps trade away the depth-2
+        # device/host overlap, so sustained non-repetitive traffic (every
+        # proposal empty or rejected) would pay that tax forever. After
+        # SPEC_MISS_DEMOTE consecutive zero-accept steps the loop reverts
+        # to the pipelined path for SPEC_RETRY_EVERY steps, then re-probes
+        # (prompt-lookup hit rate changes as the answer starts quoting
+        # retrieved rows, so a one-way demotion would miss the recovery).
+        self._spec_miss_streak = 0
+        self._spec_cooldown = 0
         # shared-prefix KV cache: matched at admission so identical prompt
         # heads (the constant system prompt every conversation shares) are
         # prefilled ONCE per process instead of per request — see
         # register_prefix / retire_prefixes
         self._prefixes: list[_PrefixEntry] = []
         self._n_prefixes_ever = 0  # unique allocator owner ids
+        self._prefix_jobs: deque[_PrefixJob] = deque()
 
     # --- public API -----------------------------------------------------
     async def start(self) -> None:
@@ -165,6 +199,8 @@ class ContinuousBatchingScheduler:
         self._wakeup.set()
         if self._task:
             await self._task
+        for job in list(self._prefix_jobs):  # shutdown mid-registration
+            self._fail_prefix_job(job)
 
     async def submit(
         self,
@@ -213,22 +249,10 @@ class ContinuousBatchingScheduler:
         request. Returns the shared token length (0 = nothing registered).
         Call while the engine is idle (startup) or when a slot is free.
         """
-        page = self.engine.page_size
-        n_pages = min(len(prompt_ids) // page, self.engine.max_pages_per_seq)
-        if n_pages <= 0:
-            return 0
-        shared_len = n_pages * page
-        ids = list(prompt_ids[:shared_len])
-        for entry in self._prefixes:
-            if not entry.retired and entry.shared_len == shared_len and entry.ids == ids:
-                return shared_len  # already registered
-        if not self.allocator.can_allocate(n_pages) or not self.free_slots:
-            logger.warning("prefix cache: no pages/slot free; not registering")
-            return 0
-        owner = f"__prefix_{self._n_prefixes_ever}__"
-        self._n_prefixes_ever += 1
-        pages = self.allocator.allocate(owner, n_pages)
-        slot = self.free_slots.pop()
+        prep = self._prefix_prep(prompt_ids)
+        if not isinstance(prep, tuple):
+            return prep  # 0 (unregistrable) or an existing entry's length
+        ids, shared_len, owner, pages, slot = prep
         try:
             self.engine.set_page_table_row(slot, pages)
             self.engine.prefill(slot, ids)  # fills exactly the shared pages
@@ -240,8 +264,73 @@ class ContinuousBatchingScheduler:
             self.free_slots.append(slot)
         self._prefixes.append(_PrefixEntry(ids, pages, shared_len, owner))
         logger.info("prefix cache: registered %d shared tokens (%d pages)",
-                    shared_len, n_pages)
+                    shared_len, len(pages))
         return shared_len
+
+    def _prefix_prep(self, prompt_ids: list[int]):
+        """Shared admission logic for both register_prefix variants: size
+        the whole-page head, dedupe against live entries, reserve pages and
+        an engine slot. Returns an int (0 = unregistrable / no capacity, or
+        an already-registered entry's shared length) or the reservation
+        tuple ``(ids, shared_len, owner, pages, slot)``."""
+        page = self.engine.page_size
+        n_pages = min(len(prompt_ids) // page, self.engine.max_pages_per_seq)
+        if n_pages <= 0:
+            return 0
+        shared_len = n_pages * page
+        ids = list(prompt_ids[:shared_len])
+        for entry in self._prefixes:
+            if not entry.retired and entry.shared_len == shared_len and entry.ids == ids:
+                return shared_len  # already registered
+        for job in self._prefix_jobs:
+            if job.shared_len == shared_len and job.ids == ids:
+                return 0  # registration already in flight; caller may retry
+        if not self.allocator.can_allocate(n_pages) or not self.free_slots:
+            logger.warning("prefix cache: no pages/slot free; not registering")
+            return 0
+        owner = f"__prefix_{self._n_prefixes_ever}__"
+        self._n_prefixes_ever += 1
+        pages = self.allocator.allocate(owner, n_pages)
+        slot = self.free_slots.pop()
+        return ids, shared_len, owner, pages, slot
+
+    async def register_prefix_async(self, prompt_ids: list[int]) -> int:
+        """register_prefix for a RUNNING scheduler: the head prefills one
+        chunk per prefill round instead of one monolithic inline prefill,
+        so in-flight decode streams keep advancing (a decode step
+        interleaves with every round — the midnight refresh stops being a
+        multi-second stall for every live stream). Resolves to the shared
+        token length, 0 on failure (registration is best-effort by
+        contract, same as the sync path)."""
+        if not self._running:
+            return self.register_prefix(prompt_ids)  # engine idle: inline
+        prep = self._prefix_prep(prompt_ids)
+        if not isinstance(prep, tuple):
+            return prep
+        ids, shared_len, owner, pages, slot = prep
+        job = _PrefixJob(
+            ids=ids, shared_len=shared_len, owner=owner, pages=pages,
+            slot=slot, future=asyncio.get_running_loop().create_future(),
+        )
+        try:
+            self.engine.set_page_table_row(slot, pages)
+        except Exception:
+            # return the reservation (slot + pages) — a transient device
+            # error here must not leak them (the refresh loop retries)
+            self.allocator.free(owner, pages)
+            self.free_slots.append(slot)
+            raise
+        self._prefix_jobs.append(job)
+        self._wakeup.set()
+        return await job.future
+
+    def _fail_prefix_job(self, job: _PrefixJob) -> None:
+        self._prefix_jobs.remove(job)
+        self.allocator.free(job.owner, job.pages)
+        self.engine.reset_slot(job.slot)
+        self.free_slots.append(job.slot)
+        if not job.future.done():
+            job.future.set_result(0)
 
     def retire_prefixes(self) -> None:
         """Stop matching every registered prefix (the caller is about to
@@ -412,20 +501,25 @@ class ContinuousBatchingScheduler:
                 continue
             batch.append(handle)
 
-        if batch:
+        # chunked prefix registrations (register_prefix_async) ride the
+        # same batched step: one chunk per round, no logits needed
+        jobs = list(self._prefix_jobs)
+        if batch or jobs:
             from finchat_tpu.engine.engine import round_up_pow2
 
-            N = round_up_pow2(len(batch))
+            rows = [(h.slot, h.prompt_ids, h.prefill_pos) for h in batch]
+            rows += [(j.slot, j.ids, j.pos) for j in jobs]
+            N = round_up_pow2(len(rows))
             tokens = np.zeros((N, C), np.int32)
             slots = np.zeros((N,), np.int32)
             starts = np.zeros((N,), np.int32)
             n_valids = np.zeros((N,), np.int32)
-            slots[:] = batch[0].slot  # padding rows: n_valid 0 → trash writes
-            for i, handle in enumerate(batch):
-                chunk = handle.prompt_ids[handle.prefill_pos : handle.prefill_pos + C]
+            slots[:] = rows[0][0]  # padding rows: n_valid 0 → trash writes
+            for i, (slot, ids, pos) in enumerate(rows):
+                chunk = ids[pos : pos + C]
                 tokens[i, : len(chunk)] = chunk
-                slots[i] = handle.slot
-                starts[i] = handle.prefill_pos
+                slots[i] = slot
+                starts[i] = pos
                 n_valids[i] = len(chunk)
             with Timer(METRICS, "finchat_prefill_seconds"):
                 # host-side dispatch time for the round (device work is
@@ -441,6 +535,21 @@ class ContinuousBatchingScheduler:
                 handle.prefill_pos += int(n_valids[i])
                 if handle.prefill_pos >= len(handle.prompt_ids):
                     completions.append((handle, logits[i]))
+            for i, job in enumerate(jobs, start=len(batch)):
+                job.pos += int(n_valids[i])
+                if job.pos >= job.shared_len:
+                    self._prefix_jobs.remove(job)
+                    self.engine.reset_slot(job.slot)
+                    self.free_slots.append(job.slot)
+                    self._prefixes.append(
+                        _PrefixEntry(job.ids, job.pages, job.shared_len, job.owner)
+                    )
+                    logger.info(
+                        "prefix cache: registered %d shared tokens (%d pages, chunked)",
+                        job.shared_len, len(job.pages),
+                    )
+                    if not job.future.done():
+                        job.future.set_result(job.shared_len)
 
         if not completions:
             return  # dispatch-only round, no host sync needed
@@ -566,6 +675,21 @@ class ContinuousBatchingScheduler:
         self.engine.set_last_token(handle.slot, token)
         return token
 
+    def _spec_note_step(self, *, accepted: int) -> None:
+        """Track the zero-accept streak behind the spec path's demotion:
+        SPEC_MISS_DEMOTE consecutive steps with no accepted draft tokens
+        put the loop back on the pipelined depth-2 path for
+        SPEC_RETRY_EVERY steps (the depth-1 verify cadence only pays for
+        itself when drafts land — see class constants)."""
+        if accepted > 0:
+            self._spec_miss_streak = 0
+            return
+        self._spec_miss_streak += 1
+        if self._spec_miss_streak >= self.SPEC_MISS_DEMOTE:
+            self._spec_miss_streak = 0
+            self._spec_cooldown = self.SPEC_RETRY_EVERY
+            METRICS.inc("finchat_spec_demotions_total")
+
     async def _run_spec_step(self) -> None:
         """One speculative verify step: propose drafts from each greedy
         slot's n-gram index, score them all in one forward, deliver the
@@ -600,6 +724,7 @@ class ContinuousBatchingScheduler:
             # Kd+1-wide verify forward would cost K× the query compute for
             # an unconditional n_emitted == 1 — run the plain (cheaper,
             # already-warmed) decode step instead
+            self._spec_note_step(accepted=0)
             await self._consume_step(self._dispatch_decode())
             return
 
@@ -643,6 +768,7 @@ class ContinuousBatchingScheduler:
                     break
         if accepted_total:
             METRICS.inc("finchat_spec_tokens_accepted_total", accepted_total)
+        self._spec_note_step(accepted=accepted_total)
         METRICS.set_gauge("finchat_batch_occupancy", len(self.decoding))
 
     async def _consume_step(self, step: _InFlightStep) -> None:
@@ -672,7 +798,8 @@ class ContinuousBatchingScheduler:
         logger.info("scheduler loop started (max_seqs=%d)", self.engine.engine_cfg.max_seqs)
         inflight: _InFlightStep | None = None
         while self._running:
-            if not (self.pending or self.prefilling or self.decoding):
+            if not (self.pending or self.prefilling or self.decoding
+                    or self._prefix_jobs):
                 if inflight is not None:  # drain the pipeline before idling
                     await self._consume_step(inflight)
                     inflight = None
@@ -689,7 +816,7 @@ class ContinuousBatchingScheduler:
             # one batched prefill round (all prefilling sequences advance a
             # chunk together), interleaved with decode so TTFT work cannot
             # starve in-flight streams
-            if self.prefilling:
+            if self.prefilling or self._prefix_jobs:
                 try:
                     await self._prefill_round()
                 except Exception as e:
@@ -698,8 +825,17 @@ class ContinuousBatchingScheduler:
                     logger.error("prefill round error: %s", e)
                     for handle in list(self.prefilling):
                         self._evict(handle, "error", error=str(e))
+                    for job in list(self._prefix_jobs):
+                        self._fail_prefix_job(job)
 
-            if self.decoding and self.spec_k > 0 and self._spec_candidates():
+            if self._spec_cooldown > 0:
+                # demoted after sustained all-miss steps: count pipelined
+                # steps down to the next spec re-probe
+                self._spec_cooldown -= 1
+            if (
+                self.decoding and self.spec_k > 0
+                and self._spec_cooldown == 0 and self._spec_candidates()
+            ):
                 try:
                     # speculative decode is depth-1: constrained picks land
                     # before the next dispatch, so no slot ever sits a step

@@ -8,8 +8,15 @@ heavily overlaps the prompt. Prompt-lookup decoding (n-gram matching
 against the sequence's own token history) drafts those continuations for
 free on the host: no draft model, no extra device memory, and the verify
 step (engine.verify_step) scores all drafts in one weights-read. On a
-miss the sequence degrades to plain one-token decode — never worse than
-the non-speculative path, token-for-token identical under greedy.
+miss the sequence degrades to plain one-token decode — token-for-token
+identical to the non-speculative path under greedy, and each verify step
+costs about the same device time as a decode step (measured ~1.07x, see
+PERF_r04.md). Throughput is not strictly never-worse, though: the
+scheduler's spec mode runs depth-1 (dispatch then consume serially), so
+on sustained all-miss traffic it gives up the depth-2 device/host
+overlap of the plain decode path. The scheduler therefore drops a
+sequence back to the pipelined non-spec path after
+``SPEC_MISS_DEMOTE`` consecutive empty/all-rejected proposals.
 
 ``NgramIndex`` is incremental — O(n-gram widths) per appended token and
 O(1) per proposal — because the scheduler proposes on the asyncio event

@@ -88,6 +88,21 @@ def decode_state_shardings(mesh: Mesh, n_kv_heads: int | None = None) -> dict[st
             mesh.shape.get("model", 1), n_kv_heads,
         )
     kv_spec = ns(None, None, None, "model") if kv_whole_heads else ns(None, None, None, None)
+    # int8-KV scale arrays [L, P, pad8(Hkv), PS]: the head ROW dim (2)
+    # splits over the model axis in the same whole-KV-head blocks the fused
+    # page minor dim does — but only when the sublane padding can't
+    # interleave with the split (Hkv % 8 == 0 makes pad8(Hkv) == Hkv, so row
+    # blocks == head blocks and the placement is communication-free,
+    # matching Llama-3-8B/70B's Hkv=8). Otherwise they replicate: scales
+    # are ~6% of the pages' bytes, so replication is cheap and strictly
+    # better than a misaligned shard that GSPMD would repair with gathers.
+    # When kv_quant is off these leaves are (1,1,1,1) placeholders and
+    # _fit_sharding quietly replicates them.
+    scale_spec = (
+        ns(None, None, "model", None)
+        if kv_whole_heads and n_kv_heads is not None and n_kv_heads % 8 == 0
+        else ns(None, None, None, None)
+    )
     return {
         # [L, pages, page_size, Hkv*hd] — the fused KV-head dim on the model
         # axis (head-major within the fused dim, so a model-axis shard is a
@@ -95,11 +110,8 @@ def decode_state_shardings(mesh: Mesh, n_kv_heads: int | None = None) -> dict[st
         # keeping cache writes local)
         "k_pages": kv_spec,
         "v_pages": kv_spec,
-        # int8-KV scale arrays: (1,1,1,1) placeholders whenever a mesh is
-        # in play (kv_quant is single-chip only) — replicated so every
-        # DecodeState leaf still gets an explicit placement
-        "k_scales": ns(None, None, None, None),
-        "v_scales": ns(None, None, None, None),
+        "k_scales": scale_spec,
+        "v_scales": scale_spec,
         "page_table": ns(None, None),
         "context_lens": ns(None),
         "last_tokens": ns(None),
@@ -138,10 +150,11 @@ def _fit_sharding(
                     f"divisible by mesh axes {axes!r} = {extent}; refusing to replicate "
                     "a tensor this large — fix the mesh/model config"
                 )
-            logger.warning(
-                "replicating dim of size %d (not divisible by mesh axes %r = %d)",
-                dim, axes, extent,
-            )
+            if dim > 1:  # size-1 dims (placeholder leaves) replicate silently
+                logger.warning(
+                    "replicating dim of size %d (not divisible by mesh axes %r = %d)",
+                    dim, axes, extent,
+                )
             fitted.append(None)
         else:
             fitted.append(axes)

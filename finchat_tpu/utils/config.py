@@ -99,12 +99,12 @@ class VectorConfig:
     """Vector index over user transactions.
 
     The reference delegates to a remote Qdrant (``tools/qdrant_tool.py``);
-    here the backend is the in-tree on-device index (brute-force exact
-    cosine on the MXU) with a local durable snapshot (``persist_path``).
-    ``url``/``api_key`` keep the reference's ``QDRANT_URL``/``QDRANT_API_KEY``
-    env names working for .env drop-in compatibility; since no external
-    qdrant client ships in-tree, a configured url is logged-and-ignored at
-    boot (serve/app.py) rather than silently dropped.
+    here the DEFAULT backend is the in-tree on-device index (brute-force
+    exact cosine on the MXU) with a local durable snapshot
+    (``persist_path``). Setting ``QDRANT_URL`` (the reference's env name,
+    .env drop-in compatible) selects the external Qdrant backend instead
+    (tools/qdrant_retriever.py) for deployments with an existing
+    populated cluster; embeddings stay on-device either way.
     """
 
     url: str = ""

@@ -217,15 +217,104 @@ def test_engine_int8_kv_logits_track_bf16(attn):
         token = int(np.argmax(logits_b))
 
 
-def test_kv_quant_disabled_under_mesh():
+def test_ring_prefill_int8_kv_matches_chunked():
+    """The SP/ring prefill write path quantizes too (the old engine
+    disabled kv_quant under any mesh, so this path could never see an
+    int8 cache): a long prompt prefilled through the seq-sharded ring
+    path with kv_quant=int8 must leave the cache equivalent to chunked
+    int8 prefill — same greedy continuation, close last-token logits."""
+    from finchat_tpu.models.llama import LlamaConfig
     from finchat_tpu.parallel.mesh import MeshSpec, build_mesh
 
-    mesh = build_mesh(MeshSpec(data=1, seq=1, expert=1, model=8))
-    config = PRESETS["tiny"]
-    eng = InferenceEngine(
-        config, init_params(config, jax.random.key(0)),
-        EngineConfig(max_seqs=2, page_size=8, num_pages=16, max_seq_len=64,
-                     prefill_chunk=8, kv_quant="int8"),
-        mesh=mesh,
+    config = LlamaConfig(
+        vocab_size=128, dim=64, n_layers=2, n_heads=8, n_kv_heads=8,
+        hidden_dim=128, max_seq_len=128,
     )
-    assert eng.kv_quant == "" and eng.state.k_pages.dtype != jnp.int8
+    params = init_params(config, jax.random.key(0))
+    prompt = list(np.random.RandomState(7).randint(1, 128, size=50))
+    n_new = 5
+
+    def run(mesh, ring_min):
+        ecfg = EngineConfig(
+            max_seqs=2, page_size=8, num_pages=32, max_seq_len=128,
+            prefill_chunk=16, ring_prefill_min_tokens=ring_min,
+            kv_quant="int8",
+        )
+        eng = InferenceEngine(config, params, ecfg, mesh=mesh)
+        assert eng.kv_quant == "int8" and eng.state.k_pages.dtype == jnp.int8
+        alloc = PageAllocator(ecfg.num_pages)
+        pages = alloc.allocate("s", pages_needed(len(prompt) + n_new, 8))
+        eng.set_page_table_row(0, pages)
+        if ring_min <= len(prompt) and mesh is not None:
+            assert eng._use_ring_prefill(len(prompt))
+        logits = eng.prefill(0, prompt)
+        eng.state, tok = commit_first_token(
+            eng.state, jnp.int32(0), logits, jnp.float32(0.0), jnp.float32(1.0), jnp.int32(0)
+        )
+        out = [int(tok)]
+        active = jnp.zeros((2,), bool).at[0].set(True)
+        z, o, zk = jnp.zeros((2,)), jnp.ones((2,)), jnp.zeros((2,), jnp.int32)
+        for _ in range(n_new - 1):
+            out.append(int(eng.decode(active, z, o, zk)[0]))
+        return np.asarray(logits, np.float32), out
+
+    mesh = build_mesh(MeshSpec(data=1, seq=2, expert=1, model=4))
+    ring_logits, ring_tokens = run(mesh, ring_min=16)  # ring path engaged
+    mesh_logits, mesh_tokens = run(mesh, ring_min=10_000)  # chunked, same mesh
+    # both paths quantize per-token rows at write, so the CACHED values are
+    # identical — but the prefill-time attention differs by the one-time
+    # rounding: ring attends over the exact bf16 K/V activations, chunked
+    # reads back the quantized cache. Tolerance is the quantization
+    # envelope (same 0.15 as test_engine_int8_kv_logits_track_bf16).
+    np.testing.assert_allclose(ring_logits, mesh_logits, atol=0.15)
+    # decode reads the same quantized cache in both runs; the greedy
+    # continuation AFTER the first token must agree (the first committed
+    # token comes from the differing prefill logits, so compare decode)
+    assert ring_tokens[1:] == mesh_tokens[1:] or ring_tokens == mesh_tokens
+
+
+def test_tp_sharded_int8_kv_matches_unsharded():
+    """VERDICT r4 #5: int8 KV must survive a mesh. Greedy decode through
+    the TP=8 engine with kv_quant=int8 must emit the same tokens as the
+    single-device int8 engine, with the scale arrays actually sharded over
+    their head row dim (Hkv=8 → pad8(Hkv)=Hkv, so row blocks == the page
+    shards' head blocks)."""
+    from jax.sharding import PartitionSpec as P
+
+    from finchat_tpu.engine.engine import commit_first_token
+    from finchat_tpu.models.llama import LlamaConfig
+    from finchat_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    config = LlamaConfig(
+        vocab_size=128, dim=64, n_layers=2, n_heads=8, n_kv_heads=8,
+        hidden_dim=128, max_seq_len=64,
+    )
+    params = init_params(config, jax.random.key(0))
+    ecfg = EngineConfig(max_seqs=2, page_size=8, num_pages=16, max_seq_len=64,
+                        prefill_chunk=8, kv_quant="int8")
+    prompt, n_new = [5, 9, 2, 100, 17, 3], 6
+
+    def run(mesh):
+        eng = InferenceEngine(config, params, ecfg, mesh=mesh)
+        assert eng.kv_quant == "int8"
+        assert eng.state.k_pages.dtype == jnp.int8
+        if mesh is not None:
+            assert eng.state.k_scales.sharding.spec == P(None, None, "model", None)
+            assert eng.state.v_scales.sharding.spec == P(None, None, "model", None)
+        alloc = PageAllocator(ecfg.num_pages)
+        pages = alloc.allocate("s", pages_needed(len(prompt) + n_new, 8))
+        eng.set_page_table_row(0, pages)
+        logits = eng.prefill(0, prompt)
+        eng.state, tok = commit_first_token(
+            eng.state, jnp.int32(0), logits, jnp.float32(0.0), jnp.float32(1.0), jnp.int32(0)
+        )
+        out = [int(tok)]
+        active = jnp.zeros((2,), bool).at[0].set(True)
+        z, o, zk = jnp.zeros((2,)), jnp.ones((2,)), jnp.zeros((2,), jnp.int32)
+        for _ in range(n_new - 1):
+            out.append(int(eng.decode(active, z, o, zk)[0]))
+        return out
+
+    unsharded = run(None)
+    sharded = run(build_mesh(MeshSpec(data=1, seq=1, expert=1, model=8)))
+    assert unsharded == sharded

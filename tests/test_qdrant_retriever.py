@@ -1,0 +1,193 @@
+"""External Qdrant backend contract tests (tools/qdrant_retriever.py).
+
+Mirrors test_retrieval.py's security invariants against a FAKED client
+(no qdrant-client / no network): the filter the backend receives — not
+just the post-hoc re-check — must enforce user isolation, because the
+reference treats the server-side must-filter as the security boundary
+(qdrant_tool.py:105-112) and the re-check as defense in depth.
+"""
+
+from types import SimpleNamespace
+
+import pytest
+
+from finchat_tpu.tools.qdrant_retriever import QdrantRetriever
+
+NOW = 1_700_000_000.0
+
+
+class FakeEncoder:
+    def embed_query(self, text):
+        return [0.1, 0.2, 0.3]
+
+    def embed_batch(self, texts):
+        return [[0.1 * (i + 1)] * 3 for i in range(len(texts))]
+
+
+def _hit(user_id, content, **metadata):
+    return SimpleNamespace(
+        payload={"page_content": content,
+                 "metadata": {"user_id": user_id, **metadata}}
+    )
+
+
+class FakeClient:
+    """Records calls; serves canned hits, honoring the must-filter the
+    way the real service would (so filter bugs fail the test)."""
+
+    def __init__(self, hits=()):
+        self.hits = list(hits)
+        self.query_calls = []
+        self.upsert_calls = []
+        self.raise_on_query = None
+
+    def query_points(self, *, collection_name, query, limit, query_filter,
+                     search_params, with_payload):
+        self.query_calls.append(dict(
+            collection_name=collection_name, query=query, limit=limit,
+            query_filter=query_filter, search_params=search_params,
+            with_payload=with_payload,
+        ))
+        if self.raise_on_query:
+            raise self.raise_on_query
+        out = []
+        for h in self.hits:
+            meta = h.payload["metadata"]
+            ok = True
+            for cond in query_filter["must"]:
+                field = cond["key"].split(".", 1)[1]
+                if "match" in cond and meta.get(field) != cond["match"]["value"]:
+                    ok = False
+                if "range" in cond and not meta.get(field, 0) >= cond["range"]["gte"]:
+                    ok = False
+            if ok:
+                out.append(h)
+        return SimpleNamespace(points=out[: int(limit)])
+
+    def upsert(self, *, collection_name, points):
+        self.upsert_calls.append(dict(collection_name=collection_name, points=points))
+
+
+def make(hits=(), **kw):
+    client = FakeClient(hits)
+    r = QdrantRetriever(FakeEncoder(), client=client, collection="transactions",
+                        now=lambda: NOW, **kw)
+    return r, client
+
+
+ALICE_HITS = [
+    _hit("alice", "GROCERY OUTLET $54.12", date=NOW - 86400 * 40),
+    _hit("alice", "RENT PAYMENT $2000", date=NOW - 86400 * 5),
+    _hit("alice", "COFFEE SHOP $4.50", date=NOW - 86400 * 1),
+    _hit("bob", "BOB'S SECRET PURCHASE $999", date=NOW - 100),
+]
+
+
+async def test_empty_user_id_returns_empty_without_backend_call():
+    r, client = make(ALICE_HITS)
+    assert await r({"search_query": "anything"}) == []
+    assert await r({"user_id": "", "search_query": "anything"}) == []
+    assert client.query_calls == []  # the backend is never even asked
+
+
+async def test_user_isolation_via_must_filter():
+    r, client = make(ALICE_HITS)
+    hits = await r({"user_id": "alice", "search_query": "purchases"})
+    assert len(hits) == 3
+    assert all("BOB" not in h for h in hits)
+    [call] = client.query_calls
+    assert {"key": "metadata.user_id", "match": {"value": "alice"}} in call["query_filter"]["must"]
+    assert call["collection_name"] == "transactions"
+    assert call["with_payload"] is True
+
+
+async def test_time_period_filter_becomes_date_range():
+    r, client = make(ALICE_HITS)
+    hits = await r({"user_id": "alice", "search_query": "p", "time_period_days": 7})
+    assert len(hits) == 2  # 40-day-old grocery txn filtered out
+    assert not any("GROCERY" in h for h in hits)
+    [call] = client.query_calls
+    range_conds = [c for c in call["query_filter"]["must"] if "range" in c]
+    assert range_conds == [{"key": "metadata.date",
+                            "range": {"gte": int(NOW - 7 * 86_400)}}]
+
+
+async def test_limits():
+    r, client = make(ALICE_HITS)
+    assert len(await r({"user_id": "alice", "search_query": "p",
+                        "num_transactions": 1})) == 1
+    await r({"user_id": "alice", "search_query": "p", "num_transactions": None})
+    assert client.query_calls[-1]["limit"] == 10_000  # qdrant_tool.py:145
+
+
+async def test_posthoc_recheck_skips_mismatched_hits():
+    """Even when the service misbehaves (returns another user's rows
+    despite the filter), the re-check drops them (qdrant_tool.py:159-170)."""
+    r, client = make(ALICE_HITS)
+    client.query_points = lambda **kw: SimpleNamespace(points=ALICE_HITS)
+    hits = await r({"user_id": "alice", "search_query": "p"})
+    assert len(hits) == 3 and all("BOB" not in h for h in hits)
+
+
+async def test_exception_returns_empty_list():
+    r, client = make(ALICE_HITS)
+    client.raise_on_query = ConnectionError("qdrant down")
+    assert await r({"user_id": "alice", "search_query": "p"}) == []
+
+
+async def test_structured_rows_carry_metadata():
+    r, _ = make(ALICE_HITS)
+    rows = await r.structured({"user_id": "alice", "search_query": "p"})
+    assert all(row["user_id"] == "alice" and "page_content" in row and "date" in row
+               for row in rows)
+
+
+def test_upsert_payload_shape_and_stable_ids():
+    r, client = make()
+    r.upsert_transactions("alice", ["A $1", "B $2"], dates=[NOW, NOW],
+                          metadatas=[{"amount": -1.0}, {"amount": -2.0}])
+    [call] = client.upsert_calls
+    assert call["collection_name"] == "transactions"
+    p0, p1 = call["points"]
+    assert p0["payload"]["page_content"] == "A $1"
+    assert p0["payload"]["metadata"] == {"amount": -1.0, "user_id": "alice", "date": NOW}
+    assert p0["id"] != p1["id"]
+    # stable identity: re-upserting the same row produces the same id
+    r.upsert_transactions("alice", ["A $1"], dates=[NOW])
+    assert client.upsert_calls[-1]["points"][0]["id"] == p0["id"]
+
+
+def test_build_app_selects_qdrant_backend(monkeypatch):
+    """QDRANT_URL flips the backend; the serve-time warning is gone."""
+    import asyncio
+
+    from finchat_tpu.serve.app import build_app
+    from finchat_tpu.tools.qdrant_retriever import QdrantRetriever as QR
+    import finchat_tpu.tools.qdrant_retriever as qr_mod
+
+    built = {}
+
+    def fake_init(self, encoder, **kw):
+        built.update(kw)
+        self.client = FakeClient()
+        self.encoder = encoder
+        self.collection = kw.get("collection", "transactions")
+        self.default_limit = kw.get("default_limit", 10_000)
+        self.now = __import__("time").time
+
+    monkeypatch.setattr(qr_mod.QdrantRetriever, "__init__", fake_init)
+    from finchat_tpu.utils.config import AppConfig
+
+    cfg = AppConfig()
+    cfg.model.preset = "stub"
+    cfg.vector.url = "http://qdrant.example:6333"
+    cfg.vector.api_key = "k"
+    app = build_app(cfg)
+    assert isinstance(app.retriever, QR)
+    assert built["url"] == "http://qdrant.example:6333"
+    assert built["collection"] == "transactions"
+    # ingestion path works against the external backend (no .index, no
+    # snapshot — _persist_index must no-op, not crash)
+    n = app._ingest_rows("alice", [{"text": "X $9"}])
+    assert n == 1
+    assert len(app.retriever.client.upsert_calls) == 1

@@ -288,6 +288,42 @@ def test_scheduler_spec_with_constrained_slot(params):
     assert counts["tool"] >= 1  # grammar emitted something before closing
 
 
+def test_spec_all_miss_demotes_then_reprobes(params):
+    """Sustained zero-accept verify steps must demote the scheduler to the
+    pipelined depth-2 path (ADVICE r4: depth-1 spec on all-miss traffic
+    loses the device/host overlap), and the cooldown must re-arm the spec
+    path afterwards rather than demoting one-way."""
+    import dataclasses as dc
+
+    from finchat_tpu.engine.scheduler import ContinuousBatchingScheduler
+    from finchat_tpu.models.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer()
+    cfg = dc.replace(ENGINE_CFG, spec_tokens=3)
+    eng = InferenceEngine(CONFIG, params, cfg)
+    sched = ContinuousBatchingScheduler(eng, eos_id=tok.eos_id)
+
+    for _ in range(sched.SPEC_MISS_DEMOTE - 1):
+        sched._spec_note_step(accepted=0)
+    assert sched._spec_cooldown == 0  # streak alone must not demote
+    sched._spec_note_step(accepted=2)  # any acceptance resets the streak
+    assert sched._spec_miss_streak == 0
+    for _ in range(sched.SPEC_MISS_DEMOTE):
+        sched._spec_note_step(accepted=0)
+    assert sched._spec_cooldown == sched.SPEC_RETRY_EVERY
+    assert sched._spec_miss_streak == 0  # streak consumed by the demotion
+
+
+def test_spec_stream_exact_under_demotion(params):
+    """A non-repetitive prompt drives all-miss verify steps through the
+    demote/re-probe cycle; the stream must still equal plain greedy
+    token-for-token (mode switches change cadence, never tokens)."""
+    plain = _run_scheduler_stream(params, 0, "q8#zLw", 24)
+    spec = _run_scheduler_stream(params, 3, "q8#zLw", 24)
+    assert spec == plain
+    assert len(plain) == 24
+
+
 def test_ngram_proposer():
     # repetition: suffix [3, 4] occurred earlier, followed by 5, 6
     assert propose_ngram_drafts([1, 2, 3, 4, 5, 6, 9, 3, 4], 2) == [5, 6]
