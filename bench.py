@@ -353,11 +353,33 @@ def measure(preset: str, batch: int, prompt_len: int, steps: int, warmup: int,
             print(f"[bench] spec section failed: {e}", file=sys.stderr, flush=True)
             spec = {"spec_error": str(e)[:200]}
 
+    # vs_baseline honesty (VERDICT r4 weak #3): the 2000 tok/s/chip target
+    # is DEFINED for llama3-8b. On the target preset the ratio is direct;
+    # on any other model it is normalized by parameter count against an 8B
+    # AT THE SAME QUANT — decode is weight-bandwidth-bound, so at matching
+    # bytes/param the params ratio IS the bytes ratio, and the figure
+    # answers "this bandwidth spent on a same-quant 8B would hit what
+    # fraction of 2000 tok/s". 6657 tok/s on tinyllama-1.1b bf16 is
+    # ~0.46x a bf16-8B-equivalent, not 3.3x. (Cross-quant comparison is
+    # NOT attempted; the basis label pins the quant.)
+    from finchat_tpu.models.llama import n_params
+
+    if preset == "llama3-8b":
+        vs_baseline = tok_s / BASELINE_TOK_S_PER_CHIP
+        basis = "direct (target model)"
+    else:
+        ratio = n_params(config) / n_params(PRESETS["llama3-8b"])
+        vs_baseline = tok_s * ratio / BASELINE_TOK_S_PER_CHIP
+        basis = (f"normalized to a llama3-8b at matching quant "
+                 f"({quant or 'bf16'}): params x{ratio:.3f}")
+
     return {
         "metric": "decode_tok_s_per_chip",
         "value": round(tok_s, 1),
         "unit": "tok/s/chip",
-        "vs_baseline": round(tok_s / BASELINE_TOK_S_PER_CHIP, 3),
+        "vs_baseline": round(vs_baseline, 3),
+        "vs_baseline_basis": basis,
+        "baseline_model": "llama3-8b",
         "model": preset,
         "attn": attn,
         "quant": quant or "bf16",

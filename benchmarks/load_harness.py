@@ -38,6 +38,8 @@ BASELINE_TTFT_P50_S = 0.300  # BASELINE.md: p50 TTFT <= 300 ms
 async def run_load(
     preset: str, sessions: int, prompt_len: int, new_tokens: int,
     page_size: int, prefill_chunk: int, shared_prefix: int = 0,
+    spec_tokens: int = 0, temperature: float = 0.5,
+    quant: str = "", kv_quant: str = "",
 ) -> dict:
     from finchat_tpu.engine.engine import InferenceEngine
     from finchat_tpu.engine.generator import EngineGenerator
@@ -57,10 +59,21 @@ async def run_load(
         max_seq_len=max_len,
         prefill_chunk=prefill_chunk,
         max_new_tokens=new_tokens,
+        # --spec-tokens engages the verify-step path; note spec only
+        # drafts for GREEDY slots, so pair with --temperature 0
+        spec_tokens=spec_tokens,
+        kv_quant=kv_quant,
     )
     tok = ByteTokenizer()
-    params = init_params(config, jax.random.key(0))
-    engine = InferenceEngine(config, params, engine_cfg)
+    if quant:
+        # leaf-at-a-time quantized init (the full bf16 tree for llama3-8b
+        # exceeds one v5e chip's HBM — same policy as bench.py)
+        from finchat_tpu.models.quant import init_quantized_llama_params
+
+        params = init_quantized_llama_params(config, jax.random.key(0))
+    else:
+        params = init_params(config, jax.random.key(0))
+    engine = InferenceEngine(config, params, engine_cfg, quant=quant)
     # production startup behavior (serve/app.py): compile every step
     # variant BEFORE traffic, so TTFT measures serving, not XLA
     warmup_s = engine.warmup()
@@ -88,7 +101,7 @@ async def run_load(
         head + "".join(chr(int(c)) for c in rng.integers(97, 122, size=tail_len))
         for _ in range(sessions)
     ]
-    sampling = SamplingParams(temperature=0.5, max_new_tokens=new_tokens)
+    sampling = SamplingParams(temperature=temperature, max_new_tokens=new_tokens)
 
     ttfts: list[float] = []
     finishes: list[float] = []
@@ -133,6 +146,10 @@ async def run_load(
         # the ACTUAL shared length register_prefix accepted (whole pages
         # only; 0 = the cache never engaged, whatever --shared-prefix said)
         "shared_prefix_tokens": registered_tokens,
+        "spec_tokens": spec_tokens,
+        "temperature": temperature,
+        "quant": quant or "bf16",
+        "kv_quant": kv_quant or "off",
         "model": preset,
         "platform": jax.devices()[0].platform,
     }
@@ -159,11 +176,19 @@ def main() -> None:
     p.add_argument("--shared-prefix", type=int, default=0,
                    help="chars of common prompt head registered with the "
                         "shared-prefix KV cache (the system-prompt shape)")
+    p.add_argument("--spec-tokens", type=int, default=0,
+                   help="prompt-lookup draft depth (greedy slots only; "
+                        "pair with --temperature 0)")
+    p.add_argument("--temperature", type=float, default=0.5)
+    p.add_argument("--quant", choices=("int8",), default=None)
+    p.add_argument("--kv-quant", choices=("int8",), default=None)
     args = p.parse_args()
     result = asyncio.run(
         run_load(
             args.preset, args.sessions, args.prompt_len, args.new_tokens,
             args.page_size, args.prefill_chunk, args.shared_prefix,
+            args.spec_tokens, args.temperature,
+            args.quant or "", args.kv_quant or "",
         )
     )
     print(json.dumps(result))

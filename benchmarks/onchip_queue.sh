@@ -1,0 +1,48 @@
+#!/bin/bash
+# Round-5 on-chip capture queue — run the moment the tunnel probe passes.
+#
+# Captures, in priority order (VERDICT r4 next-round items 3, 1, 2):
+#   1. PALLAS_ONCHIP_r05.json — 11-test interpret=False kernel parity
+#   2. BENCH_8B_r05.json      — llama3-8b int8+int8KV decode headline
+#   3. TTFT_r05_tpu*.json     — 64-session load, plain vs shared-prefix
+#
+# Each step is independently re-runnable and failure-recording; a wedged
+# tunnel mid-queue leaves earlier artifacts intact. Serial on purpose —
+# the chip is single-tenant through the tunnel.
+set -u
+cd "$(dirname "$0")/.."
+
+probe() {
+  timeout 100 python -c "import jax, jax.numpy as jnp; print((jnp.ones((256,256),jnp.bfloat16)@jnp.ones((256,256),jnp.bfloat16))[0,0])" >/dev/null 2>&1
+}
+
+echo "[queue] probing tunnel..." >&2
+if ! probe; then
+  echo "[queue] tunnel wedged; aborting (nothing written)" >&2
+  exit 1
+fi
+echo "[queue] tunnel LIVE" >&2
+
+echo "[queue] 1/4 pallas on-chip parity" >&2
+python benchmarks/pallas_onchip.py PALLAS_ONCHIP_r05.json || true
+
+echo "[queue] 2/4 llama3-8b int8 headline bench" >&2
+timeout 3000 python bench.py --preset llama3-8b --quant int8 --kv-quant int8 \
+  > BENCH_8B_r05.json 2> BENCH_8B_r05.log || true
+tail -1 BENCH_8B_r05.json || true
+
+echo "[queue] 3/4 TTFT 64 sessions (llama3-8b int8), plain" >&2
+timeout 2400 python benchmarks/load_harness.py --preset llama3-8b \
+  --quant int8 --kv-quant int8 --sessions 64 \
+  --prompt-len 4096 --new-tokens 64 --shared-prefix 0 \
+  > TTFT_r05_tpu.json 2> TTFT_r05_tpu.log || true
+tail -1 TTFT_r05_tpu.json || true
+
+echo "[queue] 4/4 TTFT 64 sessions (llama3-8b int8), shared 3k head" >&2
+timeout 2400 python benchmarks/load_harness.py --preset llama3-8b \
+  --quant int8 --kv-quant int8 --sessions 64 \
+  --prompt-len 4096 --new-tokens 64 --shared-prefix 3072 \
+  > TTFT_r05_tpu_prefix.json 2> TTFT_r05_tpu_prefix.log || true
+tail -1 TTFT_r05_tpu_prefix.json || true
+
+echo "[queue] done — artifacts: PALLAS_ONCHIP_r05.json BENCH_8B_r05.json TTFT_r05_tpu*.json" >&2

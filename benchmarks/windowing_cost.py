@@ -1,0 +1,115 @@
+"""Measure `_fit_prompt` windowing cost at the 32k-token edge.
+
+VERDICT r4 weak #7: the binary search rebuilds + re-tokenizes the full
+prompt O(log turns) times per request ON THE EVENT LOOP; with
+ring-eligible 32k-token prompts each count_tokens pass is itself
+nontrivial. This harness measures the worst realistic case — a prompt
+over budget on both axes (deep history AND a large retrieved block) —
+so the 64-session TPU TTFT runs have a host-side cost bound.
+
+Host-only (tokenizer + string work — no device). Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class _BudgetedStub:
+    """count_tokens/prompt_budget like EngineGenerator's, byte tokenizer."""
+
+    def __init__(self, tokenizer, budget: int):
+        self._tok = tokenizer
+        self._budget = budget
+
+    def prompt_budget(self, sampling) -> int:
+        return self._budget
+
+    def count_tokens(self, text: str) -> int:
+        return len(self._tok.encode(text, add_bos=True))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--turns", type=int, default=200)
+    p.add_argument("--rows", type=int, default=500)
+    p.add_argument("--budget", type=int, default=28_000)
+    p.add_argument("--iters", type=int, default=20)
+    args = p.parse_args()
+
+    from finchat_tpu.agent.graph import LLMAgent
+    from finchat_tpu.agent.state import AgentState
+    from finchat_tpu.engine.sampler import SamplingParams
+    from finchat_tpu.io.schemas import AI_SENDER, USER_SENDER, ChatMessage
+    from finchat_tpu.models.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer()
+    gen = _BudgetedStub(tok, args.budget)
+    agent = LLMAgent(gen, gen, None, "SYSTEM " * 200, "TOOL " * 200)
+    sampling = SamplingParams(temperature=0.0, max_new_tokens=512)
+
+    def fresh_state():
+        return AgentState(
+            user_query="summarize my spending this quarter by category",
+            user_id="u",
+            user_context="name: Pat\nincome: 9000\nsavings_goal: 20000",
+            chat_history=[
+                ChatMessage(
+                    sender=USER_SENDER if i % 2 == 0 else AI_SENDER,
+                    message=f"turn {i}: " + "lorem ipsum dolor sit amet " * 6,
+                )
+                for i in range(args.turns)
+            ],
+            retrieved_transactions=[
+                f"2026-0{1 + i % 9}-{1 + i % 27:02d} MERCHANT_{i % 40} ${(i * 7.13) % 900:.2f}"
+                for i in range(args.rows)
+            ],
+        )
+
+    t_counts = []
+    windowed_tokens = None
+    for _ in range(args.iters):
+        s = fresh_state()
+        t0 = time.perf_counter()
+        text = agent._response_prompt_text(s)  # build + _fit_prompt
+        t_counts.append(time.perf_counter() - t0)
+        windowed_tokens = gen.count_tokens(text)
+    t_counts.sort()
+    p50 = t_counts[len(t_counts) // 2]
+    p95 = t_counts[min(int(len(t_counts) * 0.95), len(t_counts) - 1)]
+
+    # cost of ONE count_tokens pass at ~budget size (the unit the binary
+    # search multiplies by O(log turns))
+    import statistics
+
+    base_text = "x" * args.budget  # ~budget bytes ≈ budget byte-tokens
+    reps = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        gen.count_tokens(base_text)
+        reps.append(time.perf_counter() - t0)
+    one_count = statistics.median(reps)
+
+    print(json.dumps({
+        "metric": "fit_prompt_ms",
+        "value": round(p50 * 1000, 2),
+        "unit": "ms",
+        "vs_baseline": None,
+        "p95_ms": round(p95 * 1000, 2),
+        "count_tokens_once_ms": round(one_count * 1000, 3),
+        "budget_tokens": args.budget,
+        "turns": args.turns,
+        "rows": args.rows,
+        "windowed_tokens": windowed_tokens,
+        "iters": args.iters,
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -147,19 +147,10 @@ def _paged_attention_fn(
             # prefill chunk (or jnp reference path): XLA scatter — one
             # cache copy amortized over the whole batched chunk
             with named_scope("kv_scatter"):
-                if quantized:
-                    from finchat_tpu.engine.kv_cache import scatter_kv_chunk_q8
-
-                    k_pages, v_pages, k_scales, v_scales = scatter_kv_chunk_q8(
-                        k_pages, v_pages, k_scales, v_scales, k, v,
-                        page_table, start_pos, n_valid, page_size, layer_idx,
-                        n_kv,
-                    )
-                else:
-                    k_pages, v_pages = scatter_kv_chunk(
-                        k_pages, v_pages, k, v, page_table, start_pos, n_valid,
-                        page_size, layer_idx,
-                    )
+                k_pages, v_pages, k_scales, v_scales = _scatter_kv(
+                    (k_pages, v_pages, k_scales, v_scales), k, v,
+                    page_table, start_pos, n_valid, page_size, layer_idx, n_kv,
+                )
         with named_scope("paged_attention"):
             out = paged_attention(
                 q, k_pages, v_pages, page_table, start_pos, start_pos + n_valid,
@@ -214,6 +205,27 @@ def prefill_step(
     return new_state, last_logits
 
 
+def _scatter_kv(cache, k, v, page_table, start_pos, n_valid, page_size,
+                layer_idx, n_kv):
+    """Write one chunk's K/V into the paged cache (XLA scatter),
+    dispatching on the cache dtype — the ONE place the int8-vs-native
+    write choice lives for the scatter paths (chunked prefill, ring
+    prefill, ring segments)."""
+    k_pages, v_pages, k_scales, v_scales = cache
+    if k_pages.dtype == jnp.int8:
+        from finchat_tpu.engine.kv_cache import scatter_kv_chunk_q8
+
+        return scatter_kv_chunk_q8(
+            k_pages, v_pages, k_scales, v_scales, k, v,
+            page_table, start_pos, n_valid, page_size, layer_idx, n_kv,
+        )
+    k_pages, v_pages = scatter_kv_chunk(
+        k_pages, v_pages, k, v, page_table, start_pos, n_valid,
+        page_size, layer_idx,
+    )
+    return k_pages, v_pages, k_scales, v_scales
+
+
 def _ring_prefill_attention_fn(mesh, page_table: Array, start_pos: Array, n_valid: Array,
                                page_size: int, n_kv: int, sp_mode: str = "ring"):
     """Attention callback for the seq-sharded long-prompt prefill: SP
@@ -236,21 +248,118 @@ def _ring_prefill_attention_fn(mesh, page_table: Array, start_pos: Array, n_vali
             out = ring_attention(
                 q, k, v, mesh=mesh, axis="seq", head_axis="model", causal=True
             )
-        if k_pages.dtype == jnp.int8:
-            from finchat_tpu.engine.kv_cache import scatter_kv_chunk_q8
-
-            k_pages, v_pages, k_scales, v_scales = scatter_kv_chunk_q8(
-                k_pages, v_pages, k_scales, v_scales, k, v,
-                page_table, start_pos, n_valid, page_size, layer_idx, n_kv,
-            )
-        else:
-            k_pages, v_pages = scatter_kv_chunk(
-                k_pages, v_pages, k, v, page_table, start_pos, n_valid,
-                page_size, layer_idx,
-            )
-        return out, (k_pages, v_pages, k_scales, v_scales)
+        cache = _scatter_kv(
+            (k_pages, v_pages, k_scales, v_scales), k, v,
+            page_table, start_pos, n_valid, page_size, layer_idx, n_kv,
+        )
+        return out, cache
 
     return attention
+
+
+def _ring_segment_attention_fn(mesh, page_table: Array, prefix_pages: int,
+                               start_pos: Array, n_valid: Array,
+                               page_size: int, n_kv: int):
+    """Attention callback for ONE SEGMENT of a chunked seq-sharded
+    prefill: the segment's Q/K/V ring-attend over the ``seq`` axis while
+    the ALREADY-CACHED earlier segments are gathered from their pages and
+    folded into the online-softmax carry (ops/ring_attention.py
+    ``ring_attention_with_prefix``). This is what lets the scheduler run
+    a long ring prefill in rounds interleaved with decode steps — killing
+    the every-stream stall of the monolithic path — without losing
+    cross-segment attention."""
+
+    def attention(q: Array, k: Array, v: Array, cache: Any, layer_idx: Array):
+        from finchat_tpu.engine.kv_cache import gather_kv, gather_kv_q8
+        from finchat_tpu.ops.ring_attention import ring_attention_with_prefix
+
+        k_pages, v_pages, k_scales, v_scales = cache
+        quantized = k_pages.dtype == jnp.int8
+        lay = jnp.asarray(layer_idx, jnp.int32).reshape(())
+        # the GATHER is bounded to the static prefix-page bucket (folding
+        # max_pages every segment would cost O(segments x max_seq_len));
+        # the SCATTER below keeps the full row — the segment's own pages
+        # lie past the prefix
+        gather_row = page_table[:, :prefix_pages]
+        if quantized:
+            kp, vp = gather_kv_q8(
+                k_pages, v_pages, k_scales, v_scales, gather_row, page_size,
+                lay, n_kv, dtype=q.dtype,
+            )
+        else:
+            kp, vp = gather_kv(k_pages, v_pages, gather_row, page_size, lay, n_kv)
+        out = ring_attention_with_prefix(
+            q, k, v, kp, vp, start_pos[0],
+            mesh=mesh, axis="seq", head_axis="model", causal=True,
+        )
+        # cache write AFTER the gather: the prefix fold must see only
+        # earlier segments (positions < start_pos); this segment's own
+        # tokens enter attention through the ring, not the cache
+        cache = _scatter_kv(
+            (k_pages, v_pages, k_scales, v_scales), k, v,
+            page_table, start_pos, n_valid, page_size, layer_idx, n_kv,
+        )
+        return out, cache
+
+    return attention
+
+
+@partial(jax.jit, static_argnames=("config", "page_size", "mesh", "prefix_pages"), donate_argnums=(1,))
+def ring_prefill_segment_step(
+    params: dict[str, Any],
+    state: DecodeState,
+    tokens: Array,  # [1, S] — ONE segment, padded to a seq-axis multiple
+    slot: Array,  # scalar int32
+    start_pos: Array,  # scalar int32 — absolute position of tokens[0, 0]
+    n_valid: Array,  # scalar int32 — real tokens in this segment
+    *,
+    config: LlamaConfig,
+    page_size: int,
+    mesh,
+    prefix_pages: int,
+) -> tuple[DecodeState, Array]:
+    """One segment of a chunked seq-sharded prefill (SURVEY §5.7c +
+    VERDICT r4 weak #8): segments attend to the cached earlier segments
+    via the prefix fold and to themselves via the ring, so the scheduler
+    can interleave decode steps between segments. Returns (state,
+    last-valid-token logits [vocab]) — callers use the logits of the
+    FINAL segment only.
+
+    ``prefix_pages`` (static, power-of-two-bucketed by the caller) bounds
+    the gather+fold to the pages that can actually hold the prefix —
+    without it every segment would dequantize and fold max_seq_len
+    positions per layer, costing O(segments x max_seq_len) attention
+    instead of the monolithic path's O(S^2/2)."""
+    from finchat_tpu.models.llama import lm_head
+
+    S = tokens.shape[1]
+    positions = start_pos + jnp.arange(S)[None, :]  # RoPE is absolute
+    page_row = jax.lax.dynamic_slice_in_dim(state.page_table, slot, 1, axis=0)
+
+    attention = _ring_segment_attention_fn(
+        mesh, page_row, prefix_pages, start_pos[None], n_valid[None],
+        page_size, config.n_kv_heads,
+    )
+    hidden, (k_pages, v_pages, k_scales, v_scales) = forward(
+        params, tokens, positions,
+        config=config, attention=attention,
+        cache=(state.k_pages, state.v_pages, state.k_scales, state.v_scales),
+        return_hidden=True,
+    )
+    last_hidden = jax.lax.dynamic_index_in_dim(
+        hidden[0], jnp.maximum(n_valid - 1, 0), axis=0, keepdims=False
+    )  # [D]
+    last_logits = lm_head(params, last_hidden, config=config)  # [vocab]
+
+    new_state = dataclasses.replace(
+        state,
+        k_pages=k_pages,
+        v_pages=v_pages,
+        k_scales=k_scales,
+        v_scales=v_scales,
+        context_lens=state.context_lens.at[slot].add(n_valid),
+    )
+    return new_state, last_logits
 
 
 @partial(jax.jit, static_argnames=("config", "page_size", "mesh", "sp_mode"), donate_argnums=(1,))
@@ -628,6 +737,53 @@ class InferenceEngine:
         )
         return last_logits
 
+    def ring_segment_tokens(self) -> int:
+        """Segment size for the CHUNKED ring prefill (0 = monolithic):
+        the configured ``ring_prefill_chunk`` rounded up to a seq-axis
+        multiple. Ulysses sp_mode stays monolithic (the segment step's
+        prefix fold is built on the ring body)."""
+        rc = self.engine_cfg.ring_prefill_chunk
+        if rc <= 0 or self.sp_mode != "ring" or self.mesh is None:
+            return 0
+        n_seq = self.mesh.shape.get("seq", 1)
+        return -(-rc // n_seq) * n_seq
+
+    def _prefix_page_bucket(self, start_pos: int) -> int:
+        """Static page count for a segment's prefix gather: pow-2 bucket
+        of the pages holding positions [0, start_pos), capped at the row
+        width. Floored at the pages one segment spans so prefixes shorter
+        than a segment (a shared-prefix-cache hit on the FIRST segment)
+        reuse the smallest warmed bucket instead of compiling a fresh
+        sub-rc variant on the request path — the extra gathered pages are
+        masked, and their cost is bounded by one segment's own size."""
+        floor = -(-self.ring_segment_tokens() // self.page_size)
+        need = max(-(-start_pos // self.page_size), 1)
+        return min(max(round_up_pow2(need), round_up_pow2(floor)),
+                   self.max_pages_per_seq)
+
+    def prefill_ring_segment(self, slot: int, seg_ids: list[int], start_pos: int) -> Array:
+        """One segment of a chunked seq-sharded prefill. A segment with
+        no cached prefix (``start_pos == 0``) runs the plain ring step
+        (bucketed shape shared with the monolithic path); segments with a
+        prefix — later segments, or a FIRST segment starting past a
+        shared-prefix-cache hit — run the prefix-fold step at the fixed
+        segment shape. Returns last-valid-token logits — meaningful for
+        the FINAL segment."""
+        rc = self.ring_segment_tokens()
+        assert rc > 0, "segmented ring prefill requires ring_prefill_chunk > 0"
+        n = len(seg_ids)
+        assert 0 < n <= rc
+        if start_pos == 0:
+            return self.prefill_ring(slot, seg_ids)
+        tokens = jnp.asarray(seg_ids + [0] * (rc - n), jnp.int32)[None, :]
+        self.state, last_logits = ring_prefill_segment_step(
+            self.params, self.state, tokens, jnp.int32(slot),
+            jnp.int32(start_pos), jnp.int32(n),
+            config=self.config, page_size=self.page_size, mesh=self.mesh,
+            prefix_pages=self._prefix_page_bucket(start_pos),
+        )
+        return last_logits
+
     def prefill_batch(self, items: list[tuple[int, list[int]]]) -> list[Array]:
         """Chunked prefill of N whole prompts together; returns each
         sequence's final-chunk last-token logits (one [vocab] array per
@@ -764,8 +920,20 @@ class InferenceEngine:
         # (stopping at max_seq_len itself would miss e.g. the 8192 bucket a
         # 5000-token prompt maps to under a 6000 max)
         if self.mesh is not None and self.mesh.shape.get("seq", 1) > 1:
-            S = self._ring_bucket(self.engine_cfg.ring_prefill_min_tokens)
-            top = self._ring_bucket(self.engine_cfg.max_seq_len)
+            rc = self.ring_segment_tokens()
+            # segmented: a no-prefix first segment is min(prompt, rc)
+            # tokens, so the plain-ring buckets that can actually occur
+            # are bucket(min(ring_min, rc))..bucket(rc) — when ring_min >
+            # rc every first segment is exactly rc (warming only
+            # bucket(ring_min) would leave the always-used bucket(rc)
+            # cold). Monolithic keeps the full enumeration.
+            ring_min = self.engine_cfg.ring_prefill_min_tokens
+            if rc > 0:
+                S = self._ring_bucket(min(ring_min, rc))
+                top = self._ring_bucket(rc)
+            else:
+                S = self._ring_bucket(ring_min)
+                top = self._ring_bucket(self.engine_cfg.max_seq_len)
             while True:
                 self.state, _ = ring_prefill_step(
                     self.params, self.state, jnp.zeros((1, S), jnp.int32),
@@ -776,6 +944,22 @@ class InferenceEngine:
                 if S >= top:
                     break
                 S = self._ring_bucket(S + 1)
+            if rc > 0:
+                # later segments: fixed rc shape x each prefix-page
+                # bucket a start position can map to (pow-2 enumeration,
+                # same policy as the ring buckets)
+                pb = self._prefix_page_bucket(rc)
+                top_pb = self._prefix_page_bucket(self.engine_cfg.max_seq_len)
+                while True:
+                    self.state, _ = ring_prefill_segment_step(
+                        self.params, self.state, jnp.zeros((1, rc), jnp.int32),
+                        jnp.int32(0), jnp.int32(rc), jnp.int32(0),
+                        config=self.config, page_size=self.page_size,
+                        mesh=self.mesh, prefix_pages=pb,
+                    )
+                    if pb >= top_pb:
+                        break
+                    pb = min(pb * 2, top_pb)
         np.asarray(self.state.context_lens)  # barrier: compilation done
         elapsed = time.perf_counter() - t0
         logger.info(

@@ -81,6 +81,9 @@ class SequenceHandle:
     # shared-prefix cache entry this sequence's page table references
     # (scheduler _PrefixEntry); refcounted so retirement can free safely
     prefix_entry: object | None = None
+    # on the segmented seq-sharded prefill path (prefill_pos > 0 there
+    # means "mid-ring", NOT "ride the chunked batch")
+    ring_path: bool = False
     submitted_at: float = field(default_factory=time.perf_counter)
     first_token_at: float | None = None
     finished: bool = False
@@ -381,11 +384,15 @@ class ContinuousBatchingScheduler:
             total = pages_needed(
                 len(handle.prompt_ids) + handle.sampling.max_new_tokens, self.engine.page_size
             )
-            # prompts long enough for the seq-sharded ring prefill keep it:
-            # a prefix hit would force the chunked path (ring assumes
-            # position 0), trading away the activation-memory safety the
-            # ring path exists for
-            if self.engine._use_ring_prefill(len(handle.prompt_ids)):
+            # a MONOLITHIC ring prefill assumes position 0, so a prefix
+            # hit would force such a prompt onto the chunked path —
+            # trading away the activation-memory safety the ring exists
+            # for; skip matching there. SEGMENTED ring (ring_segment_
+            # tokens > 0) composes: the first segment simply starts at
+            # shared_len with the cached head folded as prefix, so long
+            # RAG prompts keep the system-head TTFT saving.
+            if (self.engine._use_ring_prefill(len(handle.prompt_ids))
+                    and self.engine.ring_segment_tokens() == 0):
                 entry, shared_len = None, 0
             else:
                 entry, shared_len = self._match_prefix(handle.prompt_ids)
@@ -482,18 +489,36 @@ class ContinuousBatchingScheduler:
         for handle in list(self.prefilling):
             try:
                 inject("scheduler.prefill", seq_id=handle.seq_id)
-                if handle.prefill_pos == 0 and eng._use_ring_prefill(len(handle.prompt_ids)):
-                    # LATENCY TRADE: the ring prefill is one monolithic
-                    # device program — in-flight decode streams stall for
-                    # its full duration (the chunked path interleaves a
-                    # decode step per chunk). ring_prefill_min_tokens must
-                    # be set so that stall is acceptable; the ring path
-                    # buys O(S/seq) per-device activations for prompts the
-                    # chunked path cannot fit. Chunked-ring is future work.
+                if eng._use_ring_prefill(len(handle.prompt_ids)) \
+                        and (handle.prefill_pos == 0 or handle.ring_path
+                             or handle.prefix_entry is not None):
+                    rc = eng.ring_segment_tokens()
+                    if rc == 0:
+                        assert handle.prefill_pos == 0  # monolithic never
+                        # admits with a prefix hit (see _admit)
+                        # monolithic one-shot ring (ring_prefill_chunk=0
+                        # or ulysses): in-flight decode streams stall for
+                        # the whole seq-sharded prefill — the latency
+                        # trade the chunked path below exists to avoid
+                        with Timer(METRICS, "finchat_prefill_seconds"):
+                            ring_logits = eng.prefill_ring(handle.slot, handle.prompt_ids)
+                        handle.prefill_pos = len(handle.prompt_ids)
+                        completions.append((handle, ring_logits))
+                        continue
+                    # chunked ring: ONE segment per round — decode steps
+                    # interleave between segments, so one long prompt no
+                    # longer freezes every other stream (each segment
+                    # folds the cached earlier segments into its ring
+                    # attention, engine.prefill_ring_segment)
+                    handle.ring_path = True
+                    seg = handle.prompt_ids[handle.prefill_pos : handle.prefill_pos + rc]
                     with Timer(METRICS, "finchat_prefill_seconds"):
-                        ring_logits = eng.prefill_ring(handle.slot, handle.prompt_ids)
-                    handle.prefill_pos = len(handle.prompt_ids)
-                    completions.append((handle, ring_logits))
+                        seg_logits = eng.prefill_ring_segment(
+                            handle.slot, seg, handle.prefill_pos
+                        )
+                    handle.prefill_pos += len(seg)
+                    if handle.prefill_pos >= len(handle.prompt_ids):
+                        completions.append((handle, seg_logits))
                     continue
             except Exception as e:  # per-sequence isolation
                 logger.error("prefill error for %s: %s", handle.seq_id, e)

@@ -87,6 +87,22 @@ PRESETS: dict[str, LlamaConfig] = {
 }
 
 
+def n_params(config: LlamaConfig) -> int:
+    """Analytic parameter count (no materialization) — bench.py uses it
+    to weight-bytes-normalize throughput across model sizes."""
+    c = config
+    d, hd = c.dim, c.head_dim
+    attn = d * (c.n_heads * hd) + 2 * d * (c.n_kv_heads * hd) + (c.n_heads * hd) * d
+    mlp = 3 * d * c.hidden_dim
+    if c.n_experts:
+        mlp = mlp * c.n_experts + d * c.n_experts  # experts + router
+    per_layer = attn + mlp + 2 * d
+    total = c.vocab_size * d + c.n_layers * per_layer + d
+    if not c.tie_embeddings:
+        total += d * c.vocab_size
+    return total
+
+
 def init_params(
     config: LlamaConfig, key: Array, leaf_transform: Any = None
 ) -> dict[str, Any]:
@@ -215,27 +231,44 @@ def _layer(
     positions: Array,
     config: LlamaConfig,
     attention: AttentionFn,
+    tp_axis: str | None = None,
+    tp_size: int = 1,
 ) -> tuple[Array, Any]:
+    """One decoder layer. Under GSPMD (the usual path) ``tp_axis`` is
+    None — the compiler partitions from the param shardings. Under an
+    ALL-MANUAL ``shard_map`` (the stage pipeline, parallel/pipeline.py)
+    pass the TP mesh axis + size: weights arrive as Megatron shards
+    (column-parallel q/k/v/gate/up, row-parallel o/down), head counts are
+    local, and the two row-parallel outputs psum over ``tp_axis``."""
     c = config
     B, S, D = x.shape
+    hq = c.n_heads // tp_size
+    hkv = c.n_kv_heads // tp_size
 
     h = rms_norm(x, layer_params["ln_attn"], c.norm_eps)
-    q = dense(h, layer_params["attn_q"]).reshape(B, S, c.n_heads, c.head_dim)
-    k = dense(h, layer_params["attn_k"]).reshape(B, S, c.n_kv_heads, c.head_dim)
-    v = dense(h, layer_params["attn_v"]).reshape(B, S, c.n_kv_heads, c.head_dim)
+    q = dense(h, layer_params["attn_q"]).reshape(B, S, hq, c.head_dim)
+    k = dense(h, layer_params["attn_k"]).reshape(B, S, hkv, c.head_dim)
+    v = dense(h, layer_params["attn_v"]).reshape(B, S, hkv, c.head_dim)
     q = rope(q, positions, c.rope_theta)
     k = rope(k, positions, c.rope_theta)
 
     attn_out, new_layer_cache = attention(q, k, v, layer_cache, layer_idx)
-    x = x + dense(attn_out.reshape(B, S, -1), layer_params["attn_o"])
+    attn_proj = dense(attn_out.reshape(B, S, -1), layer_params["attn_o"])
+    if tp_axis is not None:
+        attn_proj = jax.lax.psum(attn_proj, tp_axis)
+    x = x + attn_proj
 
     h = rms_norm(x, layer_params["ln_mlp"], c.norm_eps)
     if c.n_experts:
+        assert tp_axis is None, "manual-TP stage blocks are dense-only (PPxEP future work)"
         x = x + moe_mlp(h, layer_params, c)
     else:
         gate = dense(h, layer_params["mlp_gate"])
         up = dense(h, layer_params["mlp_up"])
-        x = x + dense(jax.nn.silu(gate.astype(jnp.float32)).astype(up.dtype) * up, layer_params["mlp_down"])
+        down = dense(jax.nn.silu(gate.astype(jnp.float32)).astype(up.dtype) * up, layer_params["mlp_down"])
+        if tp_axis is not None:
+            down = jax.lax.psum(down, tp_axis)
+        x = x + down
     return x, new_layer_cache
 
 

@@ -28,8 +28,19 @@ from finchat_tpu.ops.refs import gqa_repeat
 _NEG = -1e30
 
 
-def _ring_body(q, k0, v0, *, axis: str, varying: tuple, n_blocks: int, causal: bool, scale: float):
-    """Per-device function under shard_map. q/k0/v0: [B, Sblk, H(kv), D]."""
+def _ring_body(q, k0, v0, *, axis: str, varying: tuple, n_blocks: int, causal: bool, scale: float,
+               prefix=None, prefix_block: int = 1024):
+    """Per-device function under shard_map. q/k0/v0: [B, Sblk, H(kv), D].
+
+    ``prefix`` (segmented serving prefill): an optional
+    ``(k_prefix, v_prefix, prefix_len)`` of ALREADY-CACHED earlier
+    tokens, replicated over the seq axis. Every prefix position precedes
+    every Q row by construction, so the fold is unmasked except for the
+    ``pos >= prefix_len`` tail (page-table padding). It seeds the online-
+    softmax carry BEFORE the ring steps — the flash-decoding-style merge
+    that lets a long prefill run as segments without losing cross-segment
+    attention. Folded blockwise (``prefix_block``) so the [Sq, P] logits
+    never materialize at full prefix length."""
     B, Sq, H, D = q.shape
     idx = lax.axis_index(axis)
     q_pos = idx * Sq + jnp.arange(Sq)  # global positions of my Q rows
@@ -81,6 +92,37 @@ def _ring_body(q, k0, v0, *, axis: str, varying: tuple, n_blocks: int, causal: b
     m0 = lax.pcast(jnp.full((B, H, Sq), _NEG, jnp.float32), varying, to="varying")
     l0 = lax.pcast(jnp.zeros((B, H, Sq), jnp.float32), varying, to="varying")
     acc0 = lax.pcast(jnp.zeros((B, H, Sq, D), jnp.float32), varying, to="varying")
+
+    if prefix is not None:
+        kp, vp, prefix_len = prefix
+        P = kp.shape[1]
+        PB = min(prefix_block, P)
+
+        while P % PB:  # static: blocks must tile the prefix exactly, or
+            PB -= 1    # the clamped last dynamic_slice would misposition
+
+        def fold_prefix_block(b, carry):
+            m, l, acc = carry
+            k_blk = lax.dynamic_slice_in_dim(kp, b * PB, PB, axis=1)
+            v_blk = lax.dynamic_slice_in_dim(vp, b * PB, PB, axis=1)
+            pos = b * PB + jnp.arange(PB)
+            k_rep = gqa_repeat(k_blk, H)
+            v_rep = gqa_repeat(v_blk, H)
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q32, k_rep.astype(jnp.float32)) * scale
+            invalid = (pos >= prefix_len)[None, None, None, :]
+            logits = jnp.where(invalid, _NEG, logits)
+            m_new = jnp.maximum(m, logits.max(axis=-1))
+            p = jnp.where(invalid, 0.0, jnp.exp(logits - m_new[..., None]))
+            corr = jnp.exp(m - m_new)
+            return (
+                m_new,
+                l * corr + p.sum(axis=-1),
+                acc * corr[..., None] + jnp.einsum(
+                    "bhqk,bkhd->bhqd", p, v_rep.astype(jnp.float32)
+                ),
+            )
+
+        m0, l0, acc0 = lax.fori_loop(0, P // PB, fold_prefix_block, (m0, l0, acc0))
     # n_blocks-1 steps each ending in a ring hop; the final block is folded
     # in WITHOUT the trailing (discarded) ppermute pair
     m, l, acc, k_last, v_last = lax.fori_loop(
@@ -117,3 +159,45 @@ def ring_attention(
         out_specs=spec,
     )
     return fn(q, k, v)
+
+
+@partial(jax.jit, static_argnames=("mesh", "axis", "batch_axis", "head_axis", "causal"))
+def ring_attention_with_prefix(
+    q: jax.Array,  # [B, S, H, D] sharded on S over `axis`
+    k: jax.Array,  # [B, S, Hkv, D]
+    v: jax.Array,
+    k_prefix: jax.Array,  # [B, P, Hkv, D] cached earlier tokens (replicated
+    v_prefix: jax.Array,  # over `axis`; may be padded past prefix_len)
+    prefix_len: jax.Array,  # scalar int32 — valid prefix positions
+    *,
+    mesh: Mesh,
+    axis: str = "seq",
+    batch_axis: str | None = None,
+    head_axis: str | None = None,
+    causal: bool = True,
+) -> jax.Array:
+    """Ring attention for ONE SEGMENT of a longer sequence: the segment's
+    Q/K/V ride the ring exactly as in ``ring_attention`` (intra-segment
+    causality is offset-invariant), while the already-cached prefix K/V is
+    folded into each device's online-softmax carry first. This is what
+    makes the seq-sharded serving prefill chunkable — segments interleave
+    with decode steps instead of one monolithic stall — without losing
+    attention to earlier segments."""
+    n_blocks = mesh.shape[axis]
+    scale = q.shape[-1] ** -0.5
+    spec = P(batch_axis, axis, head_axis, None)
+    pspec = P(batch_axis, None, head_axis, None)  # prefix: whole copy per seq shard
+    varying = tuple(a for a in (batch_axis, axis, head_axis) if a)
+
+    def body(q, k0, v0, kp, vp, plen):
+        return _ring_body(
+            q, k0, v0, axis=axis, varying=varying, n_blocks=n_blocks,
+            causal=causal, scale=scale, prefix=(kp, vp, plen),
+        )
+
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(spec, spec, spec, pspec, pspec, P()),
+        out_specs=spec,
+    )
+    return fn(q, k, v, k_prefix, v_prefix, jnp.asarray(prefix_len, jnp.int32))

@@ -11,11 +11,13 @@ The shard_map is ALL-manual: a partial-manual mapping (``axis_names=
 {'pipe'}`` with data/model left GSPMD-auto) computes the identical forward
 but its TRANSPOSE trips an XLA check failure in this toolchain ("Invalid
 binary instruction opcode copy", hlo_instruction.cc:1585) — found while
-bringing up the backward pass, round 4. Consequence: inside the pipeline,
-non-pipe mesh coordinates run replicated (stage weights live once per
-device in the stage's row), so this v1 parallelizes over ``pipe`` alone;
-re-introducing in-stage DP/TP means either the partial-manual route once
-the compiler allows it, or manual Megatron collectives in the stage block.
+bringing up the backward pass, round 4. In-stage TP therefore uses the
+OTHER route that note anticipated: manual Megatron collectives in the
+stage block (round 5) — layer weights arrive as column/row shards over
+``model`` (``_pipeline_layer_specs``) and ``models/llama._layer`` psums
+the two row-parallel projections over the axis, so a ``pipe x model``
+mesh actually partitions both ways. In-stage DP remains replicated
+(batch P() into the body); PP x SP likewise future work.
 
 Layer placement falls out of the existing stacked-layer layout: every
 ``layers`` leaf is ``[L, ...]``, so sharding the leading axis over ``pipe``
@@ -64,7 +66,8 @@ from finchat_tpu.utils.logging import get_logger
 logger = get_logger(__name__)
 
 
-def _stage_block(x, layers_local, positions, *, config, attention, remat):
+def _stage_block(x, layers_local, positions, *, config, attention, remat,
+                 tp_axis, tp_size):
     """Run this stage's local layer block (scan over L/P layers)."""
 
     def body(x, scanned):
@@ -72,6 +75,7 @@ def _stage_block(x, layers_local, positions, *, config, attention, remat):
         x, _ = _layer(
             x, layer_params, None, jnp.int32(0),
             positions=positions, config=config, attention=attention,
+            tp_axis=tp_axis, tp_size=tp_size,
         )
         return x, None
 
@@ -91,6 +95,8 @@ def _pipeline_body(
     n_stages: int,
     attention,
     remat: bool,
+    tp_axis,
+    tp_size: int,
 ):
     """Per-device pipeline schedule under shard_map (manual axis: pipe)."""
     B, S, D = x.shape
@@ -119,6 +125,7 @@ def _pipeline_body(
         act = _stage_block(
             act, layers_local, pos_mb,
             config=config, attention=attention, remat=remat,
+            tp_axis=tp_axis, tp_size=tp_size,
         )
         # bank the last stage's finished microbatch t-(P-1)
         out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1) * mb
@@ -134,6 +141,48 @@ def _pipeline_body(
     )
     # stack per-stage outputs on a leading pipe axis; caller takes the last
     return outputs[None]
+
+
+# Megatron split of a stage's layer leaves (leading dim is the stacked
+# layer axis, sharded over pipe): column-parallel out dims, row-parallel
+# in dims; everything else (norms, MoE leaves) replicated in-stage
+_TP_COL = ("attn_q", "attn_k", "attn_v", "mlp_gate", "mlp_up")
+_TP_ROW = ("attn_o", "mlp_down")
+
+
+def _stage_tp(config: LlamaConfig, mesh: Mesh) -> int:
+    """In-stage TP degree: the mesh's ``model`` extent when the head /
+    hidden dims divide it (and the model is dense); 1 (replicated, with a
+    warning) otherwise — matching v1 behavior for odd shapes."""
+    tp = mesh.shape.get("model", 1)
+    if tp == 1:
+        return 1
+    ok = (
+        not config.n_experts
+        and config.n_heads % tp == 0
+        and config.n_kv_heads % tp == 0
+        and config.hidden_dim % tp == 0
+    )
+    if not ok:
+        logger.warning(
+            "pipeline in-stage TP disabled: model axis %d does not divide "
+            "heads/kv/hidden (%d/%d/%d) or the model is MoE; stages run "
+            "replicated over model",
+            tp, config.n_heads, config.n_kv_heads, config.hidden_dim,
+        )
+        return 1
+    return tp
+
+
+def _pipeline_layer_specs(layers: dict[str, Any], tp: int) -> dict[str, Any]:
+    def spec(name: str) -> P:
+        if tp > 1 and name in _TP_COL:
+            return P("pipe", None, "model")
+        if tp > 1 and name in _TP_ROW:
+            return P("pipe", "model", None)
+        return P("pipe")
+
+    return {name: spec(name) for name in layers}
 
 
 def pipeline_forward(
@@ -159,14 +208,14 @@ def pipeline_forward(
     x = params["embed"][tokens]
     attention = make_causal_attention(attn_backend)
 
-    layer_specs = jax.tree_util.tree_map(
-        lambda _: P("pipe"), params["layers"]
-    )
+    tp = _stage_tp(config, mesh)
+    tp_axis = "model" if tp > 1 else None
+    layer_specs = _pipeline_layer_specs(params["layers"], tp)
     fn = jax.shard_map(
         partial(
             _pipeline_body,
             config=config, n_micro=n_micro, n_stages=n_stages,
-            attention=attention, remat=remat,
+            attention=attention, remat=remat, tp_axis=tp_axis, tp_size=tp,
         ),
         mesh=mesh,
         in_specs=(layer_specs, P(), P()),
@@ -221,18 +270,24 @@ def make_pipeline_train_step(
     return train_step
 
 
-def shard_params_for_pipeline(params: dict[str, Any], mesh: Mesh) -> dict[str, Any]:
-    """Place params with the stacked layer axis sharded over ``pipe``
-    (matching the pipeline's all-manual in_specs exactly, so entry incurs
-    no resharding); embed/norm/head replicated."""
+def shard_params_for_pipeline(params: dict[str, Any], mesh: Mesh,
+                              config: LlamaConfig | None = None) -> dict[str, Any]:
+    """Place params with the stacked layer axis sharded over ``pipe`` and
+    — when ``config`` is given and divisible — the Megatron dims over
+    ``model`` (matching the pipeline's all-manual in_specs exactly, so
+    entry incurs no resharding); embed/norm/head replicated."""
     from finchat_tpu.parallel.sharding import shard_params
 
     def ns(*spec):
         return NamedSharding(mesh, P(*spec))
 
+    tp = _stage_tp(config, mesh) if config is not None else 1
     shardings: dict[str, Any] = {
         "embed": ns(),
-        "layers": jax.tree_util.tree_map(lambda _: ns("pipe"), params["layers"]),
+        "layers": {
+            name: NamedSharding(mesh, spec)
+            for name, spec in _pipeline_layer_specs(params["layers"], tp).items()
+        },
         "norm": ns(),
     }
     if "lm_head" in params:

@@ -185,8 +185,8 @@ class EngineConfig:
     prefix_cache: bool = True
     # int8 paged-KV cache (kv_cache.py): halves decode-side KV HBM traffic
     # and cache footprint via per-token-per-head scales; "" = model dtype.
-    # Single-chip serving only for now (disabled with a warning under a
-    # mesh).
+    # Composes with a mesh: scales shard over their head row dim when
+    # Hkv % 8 == 0, replicate (cheaply) otherwise (parallel/sharding.py).
     kv_quant: str = ""
     # sequence-parallel mode for the seq-sharded long-prompt serving
     # prefill (SURVEY §5.7c/d): "ring" (K/V blocks rotate the ICI ring;
@@ -195,6 +195,15 @@ class EngineConfig:
     # collectives when heads divide the seq axis — falls back to ring
     # when they don't)
     sp_mode: str = "ring"
+    # chunked ring prefill: segment size (tokens) for the seq-sharded
+    # prefill. > 0 splits a ring-eligible prompt into segments that
+    # interleave with decode steps in the scheduler loop (each segment
+    # ring-attends to itself and folds the cached earlier segments —
+    # ops/ring_attention.py ring_attention_with_prefix), so one long
+    # prompt no longer stalls every in-flight stream for its whole
+    # prefill. 0 = monolithic one-shot ring prefill (ulysses sp_mode is
+    # always monolithic). Rounded up to a seq-axis multiple.
+    ring_prefill_chunk: int = 4096
 
 
 @dataclass
@@ -286,6 +295,9 @@ def load_config(
         "FINCHAT_RING_PREFILL_MIN", cfg.engine.ring_prefill_min_tokens
     )
     cfg.engine.spec_tokens = _env_int("FINCHAT_SPEC_TOKENS", cfg.engine.spec_tokens)
+    cfg.engine.ring_prefill_chunk = _env_int(
+        "FINCHAT_RING_PREFILL_CHUNK", cfg.engine.ring_prefill_chunk
+    )
     cfg.engine.sp_mode = _env("FINCHAT_SP_MODE", cfg.engine.sp_mode)
     cfg.engine.kv_quant = _env("FINCHAT_KV_QUANT", cfg.engine.kv_quant)
     cfg.engine.prefix_cache = _env_bool("FINCHAT_PREFIX_CACHE", cfg.engine.prefix_cache)

@@ -187,6 +187,209 @@ def test_ring_prefill_serving_matches_chunked():
     assert ring_tokens == mesh_tokens == plain_tokens
 
 
+def test_segmented_ring_prefill_matches_monolithic():
+    """VERDICT r4 weak #8 (chunked ring prefill): prefilling a long
+    prompt in segments — each ring-attending to itself and folding the
+    cached earlier segments (engine.prefill_ring_segment) — must leave
+    the engine in the same state as the one-shot ring prefill: same
+    final-token logits, same greedy continuation."""
+    import numpy as np
+
+    from finchat_tpu.engine.engine import InferenceEngine, commit_first_token
+    from finchat_tpu.engine.kv_cache import PageAllocator, pages_needed
+    from finchat_tpu.utils.config import EngineConfig
+
+    config = LlamaConfig(
+        vocab_size=128, dim=64, n_layers=2, n_heads=8, n_kv_heads=4,
+        hidden_dim=128, max_seq_len=256,
+    )
+    params = init_params(config, jax.random.key(0))
+    prompt = list(np.random.RandomState(11).randint(1, 128, size=100))
+    n_new = 5
+    mesh = build_mesh(MeshSpec(data=1, seq=2, expert=1, model=4))
+
+    def run(ring_chunk):
+        ecfg = EngineConfig(
+            max_seqs=2, page_size=8, num_pages=64, max_seq_len=256,
+            prefill_chunk=16, ring_prefill_min_tokens=16,
+            ring_prefill_chunk=ring_chunk,
+        )
+        eng = InferenceEngine(config, params, ecfg, mesh=mesh)
+        alloc = PageAllocator(ecfg.num_pages)
+        pages = alloc.allocate("s", pages_needed(len(prompt) + n_new, 8))
+        eng.set_page_table_row(0, pages)
+        if ring_chunk:
+            rc = eng.ring_segment_tokens()
+            assert rc == ring_chunk  # already a seq multiple here
+            logits = None
+            for start in range(0, len(prompt), rc):
+                logits = eng.prefill_ring_segment(
+                    0, prompt[start : start + rc], start
+                )
+            assert int(np.asarray(eng.state.context_lens)[0]) == len(prompt)
+        else:
+            logits = eng.prefill_ring(0, prompt)
+        eng.state, tok = commit_first_token(
+            eng.state, jnp.int32(0), logits, jnp.float32(0.0), jnp.float32(1.0), jnp.int32(0)
+        )
+        out = [int(tok)]
+        active = jnp.zeros((2,), bool).at[0].set(True)
+        z, o, zk = jnp.zeros((2,)), jnp.ones((2,)), jnp.zeros((2,), jnp.int32)
+        for _ in range(n_new - 1):
+            out.append(int(eng.decode(active, z, o, zk)[0]))
+        return np.asarray(logits, np.float32), out
+
+    mono_logits, mono_tokens = run(0)
+    seg_logits, seg_tokens = run(32)  # 100 tokens -> 4 segments
+    np.testing.assert_allclose(seg_logits, mono_logits, atol=2e-2, rtol=2e-2)
+    assert seg_tokens == mono_tokens
+
+
+def test_scheduler_decode_progress_during_ring_prefill():
+    """The 63-streams-stall cliff is dead: with chunked ring prefill on,
+    an in-flight decode stream keeps receiving tokens WHILE a long
+    ring-eligible prompt prefills, and the ring-prefilled request streams
+    the same tokens as it would with a monolithic ring prefill."""
+    import asyncio
+
+    import numpy as np
+
+    from finchat_tpu.engine.engine import InferenceEngine
+    from finchat_tpu.engine.sampler import SamplingParams
+    from finchat_tpu.engine.scheduler import ContinuousBatchingScheduler
+    from finchat_tpu.models.tokenizer import ByteTokenizer
+    from finchat_tpu.utils.config import EngineConfig
+
+    config = LlamaConfig(
+        vocab_size=300, dim=64, n_layers=2, n_heads=8, n_kv_heads=4,
+        hidden_dim=128, max_seq_len=256,
+    )
+    params = init_params(config, jax.random.key(0))
+    mesh = build_mesh(MeshSpec(data=1, seq=2, expert=1, model=4))
+    tok = ByteTokenizer()
+    long_prompt = list(np.random.RandomState(5).randint(5, 250, size=100))
+
+    async def run(ring_chunk):
+        ecfg = EngineConfig(
+            max_seqs=2, page_size=8, num_pages=64, max_seq_len=256,
+            prefill_chunk=16, ring_prefill_min_tokens=64,
+            ring_prefill_chunk=ring_chunk,
+        )
+        eng = InferenceEngine(config, params, ecfg, mesh=mesh)
+        sched = ContinuousBatchingScheduler(eng, eos_id=tok.eos_id)
+        await sched.start()
+        try:
+            stream = await sched.submit(
+                "stream", [1, 2, 3, 4, 5],
+                SamplingParams(temperature=0.0, max_new_tokens=48),
+            )
+            seen = []
+            while len(seen) < 4:  # steady-state decode first
+                event = await asyncio.wait_for(stream.events.get(), timeout=120)
+                assert event["type"] == "token", event
+                seen.append(event["token_id"])
+            ring_handle = await sched.submit(
+                "ring", long_prompt,
+                SamplingParams(temperature=0.0, max_new_tokens=6),
+            )
+            during = 0
+            ring_tokens = []
+            while ring_handle.first_token_at is None and not ring_handle.finished:
+                event = await asyncio.wait_for(stream.events.get(), timeout=120)
+                if event["type"] != "token":
+                    break
+                during += 1
+            while True:
+                event = await asyncio.wait_for(ring_handle.events.get(), timeout=120)
+                if event["type"] == "token":
+                    ring_tokens.append(event["token_id"])
+                elif event["type"] == "done":
+                    break
+                else:
+                    raise AssertionError(event)
+            return during, ring_tokens
+        finally:
+            await sched.stop()
+
+    during_seg, seg_tokens = asyncio.run(run(32))  # 100 tokens -> 4 segments
+    # the monolithic run is the token-equality oracle only; its `during`
+    # count is timing-dependent (a token can land before/after the single
+    # ring round) so the stall contrast is not asserted on it
+    _, mono_tokens = asyncio.run(run(0))
+    assert seg_tokens == mono_tokens  # same stream either way
+    # ≥3 extra prefill rounds ran with a decode step interleaving each;
+    # the stream must have advanced while the long prompt prefilled
+    assert during_seg >= 2, f"stream starved during segmented ring prefill ({during_seg})"
+
+
+def test_segmented_ring_composes_with_prefix_cache():
+    """With chunked ring prefill, a ring-eligible prompt opening with a
+    registered shared head KEEPS the prefix-cache hit (the old monolithic
+    path had to skip matching — 'ring assumes position 0'): the first
+    segment starts at shared_len with the cached head folded as prefix,
+    and the stream equals the uncached ring run token-for-token."""
+    import asyncio
+
+    import numpy as np
+
+    from finchat_tpu.engine.engine import InferenceEngine
+    from finchat_tpu.engine.sampler import SamplingParams
+    from finchat_tpu.engine.scheduler import ContinuousBatchingScheduler
+    from finchat_tpu.models.tokenizer import ByteTokenizer
+    from finchat_tpu.utils.config import EngineConfig
+
+    config = LlamaConfig(
+        vocab_size=300, dim=64, n_layers=2, n_heads=8, n_kv_heads=4,
+        hidden_dim=128, max_seq_len=256,
+    )
+    params = init_params(config, jax.random.key(0))
+    mesh = build_mesh(MeshSpec(data=1, seq=2, expert=1, model=4))
+    tok = ByteTokenizer()
+    rng = np.random.RandomState(9)
+    head = list(rng.randint(5, 250, size=48))  # 6 whole pages
+    prompt = head + list(rng.randint(5, 250, size=52))  # 100 total, ring-eligible
+
+    async def run(register):
+        ecfg = EngineConfig(
+            max_seqs=2, page_size=8, num_pages=64, max_seq_len=256,
+            prefill_chunk=16, ring_prefill_min_tokens=64,
+            ring_prefill_chunk=32,
+        )
+        eng = InferenceEngine(config, params, ecfg, mesh=mesh)
+        sched = ContinuousBatchingScheduler(eng, eos_id=tok.eos_id)
+        if register:
+            assert sched.register_prefix(head) == 48
+        await sched.start()
+        try:
+            handle = await sched.submit(
+                "s", prompt, SamplingParams(temperature=0.0, max_new_tokens=6)
+            )
+            tokens = []
+            while True:
+                event = await asyncio.wait_for(handle.events.get(), timeout=120)
+                if event["type"] == "token":
+                    tokens.append(event["token_id"])
+                elif event["type"] == "done":
+                    break
+                else:
+                    raise AssertionError(event)
+            return handle, tokens
+        finally:
+            await sched.stop()
+
+    from finchat_tpu.utils.metrics import METRICS
+
+    saved0 = METRICS.get("finchat_prefix_tokens_saved_total")
+    cached_handle, cached_tokens = asyncio.run(run(True))
+    # the hit engaged (48 head tokens never re-prefilled)...
+    assert METRICS.get("finchat_prefix_tokens_saved_total") == saved0 + 48
+    assert cached_handle.ring_path  # ...on the ring path
+    plain_handle, plain_tokens = asyncio.run(run(False))
+    assert plain_handle.ring_path
+    assert METRICS.get("finchat_prefix_tokens_saved_total") == saved0 + 48
+    assert cached_tokens == plain_tokens
+
+
 def test_ulysses_serving_prefill_matches_chunked():
     """SURVEY §5.7d: sp_mode='ulysses' must serve the seq-sharded long
     prefill with the same greedy continuation as chunked prefill, and an
@@ -378,7 +581,13 @@ def test_pipeline_forward_matches_plain():
 
     ref, _ = forward(params, tokens, positions, config=config,
                      attention=make_causal_attention("ref"))
-    sharded = shard_params_for_pipeline(params, mesh)
+    from jax.sharding import PartitionSpec as P
+
+    sharded = shard_params_for_pipeline(params, mesh, config)
+    # r5: the model=2 axis now actually partitions in-stage (Megatron
+    # column/row shards + psum in the stage block), exercised here
+    assert sharded["layers"]["attn_q"].sharding.spec == P("pipe", None, "model")
+    assert sharded["layers"]["mlp_down"].sharding.spec == P("pipe", "model", None)
     for n_micro in (1, 2, 4):
         got = pipeline_forward(
             sharded, tokens, positions, config=config, mesh=mesh, n_micro=n_micro
@@ -416,7 +625,7 @@ def test_pipeline_train_step_learns():
         hidden_dim=64, max_seq_len=32,
     )
     mesh = build_mesh(MeshSpec(data=2, pipe=2, seq=1, expert=1, model=2))
-    params = shard_params_for_pipeline(init_params(config, jax.random.key(0)), mesh)
+    params = shard_params_for_pipeline(init_params(config, jax.random.key(0)), mesh, config)
     optimizer = make_optimizer(learning_rate=1e-2)
     step = make_pipeline_train_step(config, optimizer, mesh, n_micro=2)
     state = init_train_state(config, params, optimizer)

@@ -324,6 +324,31 @@ def test_spec_stream_exact_under_demotion(params):
     assert len(plain) == 24
 
 
+def test_spec_replay_stream_semantics():
+    """benchmarks/spec_replay.py replays the scheduler's verify-step
+    semantics over a scripted stream; pin them on a hand-checkable case."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from benchmarks.spec_replay import replay_stream
+
+    # prompt establishes "1 2 -> 3 4"; answer repeats it twice
+    prompt = [9, 1, 2, 3, 4, 8]
+    answer = [1, 2, 3, 4, 1, 2, 3, 4]
+    steps, accepted, n = replay_stream(prompt, answer, k=3)
+    assert n == 8
+    # step1: no suffix match for [9? ...] -> miss, commit [1]; step2:
+    # suffix [9,1]/[1]? min_ngram=2: after [.., 8, 1] no match -> commit
+    # [2]; then suffix [1,2] matches prompt -> drafts [3,4,?]...
+    # acceptance must make this take fewer steps than tokens
+    assert steps < n
+    assert accepted == n - steps  # commits = steps + accepted
+    # a stream with no repetition gets zero acceptance, one token/step
+    steps2, accepted2, n2 = replay_stream([5, 6, 7], [10, 11, 12, 13], k=3)
+    assert (steps2, accepted2, n2) == (4, 0, 4)
+
+
 def test_ngram_proposer():
     # repetition: suffix [3, 4] occurred earlier, followed by 5, 6
     assert propose_ngram_drafts([1, 2, 3, 4, 5, 6, 9, 3, 4], 2) == [5, 6]
