@@ -16,8 +16,12 @@ OTHER route that note anticipated: manual Megatron collectives in the
 stage block (round 5) — layer weights arrive as column/row shards over
 ``model`` (``_pipeline_layer_specs``) and ``models/llama._layer`` psums
 the two row-parallel projections over the axis, so a ``pipe x model``
-mesh actually partitions both ways. In-stage DP remains replicated
-(batch P() into the body); PP x SP likewise future work.
+mesh actually partitions both ways. In-stage DP shards the batch over
+``data`` into the body (each data coordinate pipelines its own slice;
+the shard_map transpose psums layer grads over data). PP x SP shards
+the sequence over ``seq``: inside the manual region the ring body runs
+DIRECTLY (no nested shard_map) with K/V rotating via ppermute("seq") —
+see ``_sp_ring_attention``. All four axes compose in one step.
 
 Layer placement falls out of the existing stacked-layer layout: every
 ``layers`` leaf is ``[L, ...]``, so sharding the leading axis over ``pipe``
@@ -38,10 +42,9 @@ transposes to the reverse permutation), giving 1F1B-equivalent memory via
 the usual remat-on-stage trade (``remat=True`` checkpoints each stage
 block).
 
-Composition note: the pipeline body runs cache-less full attention (the
-training / long-prefill shape). SP (ring/Ulysses) composes with DP/TP in
-train_step.py; PPxSP in one step is future work — the axes are mesh-
-compatible but the pipeline feeds full-sequence blocks today.
+Composition note: the pipeline body runs cache-less attention (the
+training / long-prefill shape) — full causal when ``seq == 1``, the
+seq-sharded ring when ``seq > 1``.
 """
 
 from __future__ import annotations
@@ -87,7 +90,7 @@ def _stage_block(x, layers_local, positions, *, config, attention, remat,
 
 def _pipeline_body(
     layers_local: dict[str, Any],
-    x: jax.Array,  # [B, S, D] embedded input (replicated over pipe)
+    x: jax.Array,  # [B(/data), S, D] embedded input (replicated over pipe)
     positions: jax.Array,  # [B, S]
     *,
     config: LlamaConfig,
@@ -97,6 +100,7 @@ def _pipeline_body(
     remat: bool,
     tp_axis,
     tp_size: int,
+    carry_varying: tuple,
 ):
     """Per-device pipeline schedule under shard_map (manual axis: pipe)."""
     B, S, D = x.shape
@@ -106,8 +110,10 @@ def _pipeline_body(
     is_last = stage == n_stages - 1
     perm = [(i, i + 1) for i in range(n_stages - 1)]
 
-    held0 = lax.pcast(jnp.zeros((mb, S, D), x.dtype), ("pipe",), to="varying")
-    out0 = lax.pcast(jnp.zeros((B, S, D), x.dtype), ("pipe",), to="varying")
+    # the carries vary over pipe (per-stage) plus whatever axes the
+    # activations shard over (data / seq), passed in by the caller
+    held0 = lax.pcast(jnp.zeros((mb, S, D), x.dtype), carry_varying, to="varying")
+    out0 = lax.pcast(jnp.zeros((B, S, D), x.dtype), carry_varying, to="varying")
 
     def tick(carry, t):
         held, outputs = carry
@@ -185,6 +191,23 @@ def _pipeline_layer_specs(layers: dict[str, Any], tp: int) -> dict[str, Any]:
     return {name: spec(name) for name in layers}
 
 
+def _sp_ring_attention(varying: tuple, n_blocks: int):
+    """Stage-block attention for PP x SP: the sequence dim arrives
+    already sharded over ``seq`` (a manual axis of the enclosing
+    shard_map), so the ring body runs DIRECTLY — no nested shard_map —
+    with K/V blocks rotating via ppermute("seq")."""
+    from finchat_tpu.ops.ring_attention import _ring_body
+
+    def attention(q, k, v, cache, layer_idx):
+        out = _ring_body(
+            q, k, v, axis="seq", varying=varying, n_blocks=n_blocks,
+            causal=True, scale=q.shape[-1] ** -0.5,
+        )
+        return out, cache
+
+    return attention
+
+
 def pipeline_forward(
     params: dict[str, Any],
     tokens: jax.Array,  # [B, S] int32
@@ -203,23 +226,65 @@ def pipeline_forward(
     are small next to the layer stack)."""
     n_stages = mesh.shape["pipe"]
     assert config.n_layers % n_stages == 0, (config.n_layers, n_stages)
-    assert tokens.shape[0] % n_micro == 0, (tokens.shape, n_micro)
-
-    x = params["embed"][tokens]
-    attention = make_causal_attention(attn_backend)
-
+    # in-stage DP: the batch dim shards over `data` INTO the pipeline
+    # body when it divides (each data coordinate pipelines its own batch
+    # slice; the scan/ppermute/psum transpose sums layer grads over data
+    # automatically). Falls back to replicated batch otherwise.
+    dp = mesh.shape.get("data", 1)
+    if tokens.shape[0] % (dp * n_micro):
+        logger.warning(
+            "pipeline in-stage DP disabled: batch %d does not split into "
+            "data=%d x n_micro=%d; the data axis runs replicated",
+            tokens.shape[0], dp, n_micro,
+        )
+        dp = 1
+    assert tokens.shape[0] % (dp * n_micro) == 0, (tokens.shape, dp, n_micro)
+    # PP x SP: the sequence dim shards over `seq` into the body when it
+    # divides; the stage block then ring-attends (K/V rotate the seq
+    # ring) instead of full-sequence attention, so per-device activations
+    # are O(S/seq) on top of the microbatch split.
+    sp = mesh.shape.get("seq", 1)
+    if tokens.shape[1] % sp:
+        logger.warning(
+            "pipeline in-stage SP disabled: seq len %d not divisible by "
+            "seq axis %d; the seq axis runs replicated",
+            tokens.shape[1], sp,
+        )
+        sp = 1
+    if sp > 1 and attn_backend != "ref":
+        # the SP stage block runs the fp32 ring body directly (it must —
+        # the seq dim is already sharded in the manual region); other
+        # backends have no seq-sharded stage variant
+        logger.warning(
+            "pipeline SP stage block uses the ring attention body; "
+            "attn_backend=%r is ignored inside the pipeline", attn_backend,
+        )
     tp = _stage_tp(config, mesh)
     tp_axis = "model" if tp > 1 else None
+
+    dp_axes = ("data",) if dp > 1 else ()
+    seq_axes = ("seq",) if sp > 1 else ()
+    x_spec = P(dp_axes or None, "seq" if sp > 1 else None)
+    if sp > 1:
+        # activations inside the body vary over every engaged axis; the
+        # ring accumulators must be born with the same varying set
+        act_varying = dp_axes + ("pipe",) + seq_axes + (("model",) if tp > 1 else ())
+        attention = _sp_ring_attention(act_varying, sp)
+    else:
+        attention = make_causal_attention(attn_backend)
+
+    x = params["embed"][tokens]
     layer_specs = _pipeline_layer_specs(params["layers"], tp)
     fn = jax.shard_map(
         partial(
             _pipeline_body,
             config=config, n_micro=n_micro, n_stages=n_stages,
             attention=attention, remat=remat, tp_axis=tp_axis, tp_size=tp,
+            carry_varying=dp_axes + ("pipe",) + seq_axes,
         ),
         mesh=mesh,
-        in_specs=(layer_specs, P(), P()),
-        out_specs=P("pipe"),
+        in_specs=(layer_specs, x_spec, x_spec),
+        out_specs=P("pipe", *x_spec),
     )
     stacked = fn(params["layers"], x, positions)  # [pipe, B, S, D]
     x = stacked[-1]

@@ -562,8 +562,9 @@ def test_train_step_ulysses_sp():
 def test_pipeline_forward_matches_plain():
     """C4 (SURVEY §2.3): the GPipe-style stage pipeline at pipe=2 computes
     the SAME function as the plain scanned forward, for every microbatch
-    count (fill/drain schedule correctness). Non-pipe mesh axes run
-    replicated inside the pipeline (see parallel/pipeline.py docstring)."""
+    count (fill/drain schedule correctness). The data=2 and model=2 axes
+    of this mesh partition IN-STAGE (r5): batch shards over data when
+    n_micro divides, weights shard Megatron-style over model."""
     import numpy as np
 
     from finchat_tpu.models.llama import forward, make_causal_attention
@@ -610,6 +611,127 @@ def test_pipeline_forward_matches_plain():
         np.asarray(got2), np.asarray(ref2), atol=1e-4, rtol=1e-4,
         err_msg="per-row positions",
     )
+
+
+def test_pipeline_sp_forward_matches_plain():
+    """PP x SP: with a seq axis in the mesh the stage block ring-attends
+    over seq-sharded activations (the ring body runs directly inside the
+    all-manual region); the function computed must still equal the plain
+    scanned forward — composed with in-stage TP (data=1 on this 8-device
+    mesh; the 4-axis composition needs 16 devices and is covered by the
+    subprocess run recorded in PERF_r05.md)."""
+    import numpy as np
+
+    from finchat_tpu.models.llama import forward, make_causal_attention
+    from finchat_tpu.parallel.pipeline import pipeline_forward, shard_params_for_pipeline
+
+    config = LlamaConfig(
+        vocab_size=64, dim=32, n_layers=4, n_heads=4, n_kv_heads=2,
+        hidden_dim=64, max_seq_len=32, dtype=jnp.float32,
+    )
+    mesh = build_mesh(MeshSpec(data=1, pipe=2, seq=2, expert=1, model=2))
+    params = init_params(config, jax.random.key(0))
+    B, S = 4, 16
+    tokens = jax.random.randint(jax.random.key(1), (B, S), 0, 64)
+    positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+
+    ref, _ = forward(params, tokens, positions, config=config,
+                     attention=make_causal_attention("ref"))
+    sharded = shard_params_for_pipeline(params, mesh, config)
+    for n_micro in (1, 2):
+        got = pipeline_forward(
+            sharded, tokens, positions, config=config, mesh=mesh, n_micro=n_micro
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), atol=1e-4, rtol=1e-4,
+            err_msg=f"n_micro={n_micro}",
+        )
+
+
+def test_pipeline_sp_train_step_learns():
+    """PP x SP backward: scan + ppermute(pipe) + ring(seq) + psum(model)
+    all transpose; loss decreases memorizing one tiny batch."""
+    from finchat_tpu.parallel.pipeline import (
+        make_pipeline_train_step, shard_params_for_pipeline,
+    )
+    from finchat_tpu.train.train_step import init_train_state, make_optimizer
+
+    config = LlamaConfig(
+        vocab_size=64, dim=32, n_layers=4, n_heads=4, n_kv_heads=2,
+        hidden_dim=64, max_seq_len=32,
+    )
+    mesh = build_mesh(MeshSpec(data=1, pipe=2, seq=2, expert=1, model=2))
+    params = shard_params_for_pipeline(init_params(config, jax.random.key(0)), mesh, config)
+    optimizer = make_optimizer(learning_rate=1e-2)
+    step = make_pipeline_train_step(config, optimizer, mesh, n_micro=2)
+    state = init_train_state(config, params, optimizer)
+    tokens = jax.random.randint(jax.random.key(1), (4, 16), 0, 64)
+    losses = []
+    for _ in range(5):
+        state, loss = step(state, tokens)
+        losses.append(float(loss))
+    assert all(jnp.isfinite(jnp.asarray(losses))), losses
+    assert losses[-1] < losses[0], losses
+
+
+def test_pipeline_four_axis_composition_subprocess():
+    """pipe x data x seq x model ALL > 1 needs 16 devices — more than the
+    conftest's 8-device mesh — so it runs in a fresh subprocess with its
+    own 16-device virtual CPU mesh: forward equality vs the plain scanned
+    forward, and a learning train step."""
+    import os
+    import subprocess
+    import sys
+    import textwrap
+
+    script = textwrap.dedent("""
+        import os
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=16"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp, numpy as np
+        from finchat_tpu.models.llama import (
+            LlamaConfig, init_params, forward, make_causal_attention,
+        )
+        from finchat_tpu.parallel.mesh import MeshSpec, build_mesh
+        from finchat_tpu.parallel.pipeline import (
+            pipeline_forward, shard_params_for_pipeline, make_pipeline_train_step,
+        )
+        from finchat_tpu.train.train_step import init_train_state, make_optimizer
+
+        config = LlamaConfig(vocab_size=64, dim=32, n_layers=4, n_heads=4,
+                             n_kv_heads=2, hidden_dim=64, max_seq_len=32,
+                             dtype=jnp.float32)
+        mesh = build_mesh(MeshSpec(data=2, pipe=2, seq=2, expert=1, model=2))
+        params = init_params(config, jax.random.key(0))
+        tokens = jax.random.randint(jax.random.key(1), (4, 16), 0, 64)
+        positions = jnp.broadcast_to(jnp.arange(16), (4, 16))
+        ref, _ = forward(params, tokens, positions, config=config,
+                         attention=make_causal_attention("ref"))
+        sharded = shard_params_for_pipeline(params, mesh, config)
+        got = pipeline_forward(sharded, tokens, positions, config=config,
+                               mesh=mesh, n_micro=2)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=1e-4, rtol=1e-4)
+        opt = make_optimizer(learning_rate=1e-2)
+        step = make_pipeline_train_step(config, opt, mesh, n_micro=2)
+        state = init_train_state(config, sharded, opt)
+        losses = []
+        for _ in range(4):
+            state, loss = step(state, tokens)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0], losses
+        print("FOUR_AXIS_OK")
+    """)
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        cwd=os.path.join(os.path.dirname(__file__), ".."),
+        capture_output=True, text=True, timeout=600, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "FOUR_AXIS_OK" in proc.stdout
 
 
 def test_pipeline_train_step_learns():
