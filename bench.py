@@ -468,8 +468,9 @@ def main() -> int:
             result["degraded"] = True
             result["note"] = (
                 "TPU attempt failed (tunnel down?); CPU fallback number — "
-                "the measured on-chip record is PERF_r04.md: 6657 tok/s/chip "
-                "(vs_baseline 3.329) at these exact bench settings, 2026-07-29"
+                "the measured on-chip record is 6657 tok/s/chip on "
+                "tinyllama-1.1b bf16 (PERF_r04.md, 2026-07-29; honest "
+                "8B-equivalent vs_baseline ~0.456 per PERF_r05.md)"
             )
     print(json.dumps(result))
     return 0

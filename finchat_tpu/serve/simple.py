@@ -1,0 +1,58 @@
+"""Agent-less streaming chain — reference ``llm_service.py`` parity.
+
+The reference keeps a dormant ``LLMService`` beside the agent: a bare
+``prompt | llm`` streaming chain with no tools and no RAG
+(``llm_service.py:18-32``) — the minimum end-to-end slice (BASELINE
+config 1's single-turn chat shape, SURVEY §3.5). This is its TPU-native
+analog: the same prompt structure the agent renders (system + context /
+history / user) streamed straight through a ``TextGenerator`` — no
+graph, no retrieval, no status events.
+
+Useful for exactly what the reference kept it for: a minimal serving
+path for debugging the engine, and a fallback chat mode when the agent
+stack is not wanted.
+"""
+
+from __future__ import annotations
+
+from typing import AsyncIterator, Sequence
+
+from finchat_tpu.engine.sampler import SamplingParams
+from finchat_tpu.io.schemas import ChatMessage
+from finchat_tpu.models.tokenizer import render_chat
+from finchat_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class LLMService:
+    """``prompt | llm`` with streaming, nothing else (llm_service.py:18-32).
+
+    The generator is any ``TextGenerator`` (engine-backed in production,
+    stub in dev) — the seam the reference has at its ChatGoogleGenerativeAI
+    construction (:12-16).
+    """
+
+    def __init__(self, generator, system_prompt: str,
+                 sampling: SamplingParams | None = None):
+        self.generator = generator
+        self.system_prompt = system_prompt
+        self.sampling = sampling or SamplingParams()
+
+    async def process_message(
+        self,
+        message: str,
+        context: str = "",
+        chat_history: Sequence[ChatMessage] = (),
+        system_prompt: str | None = None,
+    ) -> AsyncIterator[str]:
+        """Stream the response to one user message (reference
+        ``process_message``, llm_service.py:21-32: same prompt pieces —
+        system + context as the system turn, history, user input — same
+        chunked streaming output)."""
+        prompt = render_chat(
+            system_prompt if system_prompt is not None else self.system_prompt,
+            context, list(chat_history), message,
+        )
+        async for chunk in self.generator.stream(prompt, self.sampling):
+            yield chunk

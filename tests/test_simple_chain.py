@@ -1,0 +1,38 @@
+"""Agent-less streaming chain (serve/simple.py — reference llm_service.py
+parity): same prompt pieces as the agent path, chunked streaming output,
+no tools/RAG/graph involved."""
+
+from finchat_tpu.engine.generator import StubGenerator
+from finchat_tpu.engine.sampler import SamplingParams
+from finchat_tpu.io.schemas import AI_SENDER, USER_SENDER, ChatMessage
+from finchat_tpu.serve.simple import LLMService
+
+
+async def test_streams_chunks_and_renders_full_prompt():
+    gen = StubGenerator(default="Hello there friend")
+    svc = LLMService(gen, "SYSTEM RULES",
+                     sampling=SamplingParams(temperature=0.0, max_new_tokens=16))
+    history = [
+        ChatMessage(sender=USER_SENDER, message="earlier question"),
+        ChatMessage(sender=AI_SENDER, message="earlier answer"),
+    ]
+    chunks = [c async for c in svc.process_message(
+        "what now?", context="name: Pat", chat_history=history,
+    )]
+    assert "".join(chunks) == "Hello there friend"
+    assert len(chunks) > 1  # streamed, not one blob
+    # the rendered prompt carries every piece, in the agent's structure
+    [prompt] = gen.calls
+    for piece in ("SYSTEM RULES", "name: Pat", "earlier question",
+                  "earlier answer", "what now?"):
+        assert piece in prompt
+    assert prompt.index("SYSTEM RULES") < prompt.index("earlier question") < prompt.index("what now?")
+
+
+async def test_per_call_system_prompt_override():
+    gen = StubGenerator(default="ok")
+    svc = LLMService(gen, "DEFAULT SYS")
+    [_ async for _ in svc.process_message("hi", system_prompt="OVERRIDE SYS")]
+    assert "OVERRIDE SYS" in gen.calls[0] and "DEFAULT SYS" not in gen.calls[0]
+    [_ async for _ in svc.process_message("hi")]
+    assert "DEFAULT SYS" in gen.calls[1]
