@@ -496,10 +496,11 @@ class ContinuousBatchingScheduler:
                     if rc == 0:
                         assert handle.prefill_pos == 0  # monolithic never
                         # admits with a prefix hit (see _admit)
-                        # monolithic one-shot ring (ring_prefill_chunk=0
-                        # or ulysses): in-flight decode streams stall for
-                        # the whole seq-sharded prefill — the latency
-                        # trade the chunked path below exists to avoid
+                        # monolithic one-shot SP prefill (only when
+                        # ring_prefill_chunk=0; both sp_modes chunk now):
+                        # in-flight decode streams stall for the whole
+                        # seq-sharded prefill — the latency trade the
+                        # chunked path below exists to avoid
                         with Timer(METRICS, "finchat_prefill_seconds"):
                             ring_logits = eng.prefill_ring(handle.slot, handle.prompt_ids)
                         handle.prefill_pos = len(handle.prompt_ids)

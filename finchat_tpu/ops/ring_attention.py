@@ -28,6 +28,51 @@ from finchat_tpu.ops.refs import gqa_repeat
 _NEG = -1e30
 
 
+def online_fold(q32, k_blk, v_blk, m, l, acc, *, scale: float, H: int, invalid):
+    """One streaming-softmax accumulation step shared by every attention
+    body that merges multiple K/V sources (ring hops, cached-prefix
+    blocks, causal segment blocks): fold ``k_blk``/``v_blk`` [B, K, Hkv, D]
+    into the carry (m, l, acc) for queries ``q32`` [B, Sq, H, D] fp32.
+    ``invalid`` broadcasts against the [B, H, Sq, K] logits; masked
+    probabilities are zeroed explicitly so fully-masked blocks contribute
+    exactly nothing (never exp'ing a -inf difference)."""
+    k_rep = gqa_repeat(k_blk, H)
+    v_rep = gqa_repeat(v_blk, H)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q32, k_rep.astype(jnp.float32)) * scale
+    logits = jnp.where(invalid, _NEG, logits)
+    m_new = jnp.maximum(m, logits.max(axis=-1))
+    p = jnp.where(invalid, 0.0, jnp.exp(logits - m_new[..., None]))
+    corr = jnp.exp(m - m_new)
+    l_new = l * corr + p.sum(axis=-1)
+    acc_new = acc * corr[..., None] + jnp.einsum(
+        "bhqk,bkhd->bhqd", p, v_rep.astype(jnp.float32)
+    )
+    return m_new, l_new, acc_new
+
+
+def fold_prefix_blocks(q32, kp, vp, prefix_len, m, l, acc, *,
+                       scale: float, H: int, prefix_block: int = 1024):
+    """Fold a cached, possibly-padded K/V prefix [B, P, Hkv, D] into the
+    online-softmax carry, blockwise so [Sq, P] logits never materialize
+    at full prefix length. Every prefix position precedes every query by
+    construction; only the ``pos >= prefix_len`` padding tail masks."""
+    P = kp.shape[1]
+    PB = min(prefix_block, P)
+    while P % PB:  # static: blocks must tile the prefix exactly, or
+        PB -= 1    # the clamped last dynamic_slice would misposition
+
+    def fold_block(b, carry):
+        m, l, acc = carry
+        k_blk = lax.dynamic_slice_in_dim(kp, b * PB, PB, axis=1)
+        v_blk = lax.dynamic_slice_in_dim(vp, b * PB, PB, axis=1)
+        pos = b * PB + jnp.arange(PB)
+        invalid = (pos >= prefix_len)[None, None, None, :]
+        return online_fold(q32, k_blk, v_blk, m, l, acc,
+                           scale=scale, H=H, invalid=invalid)
+
+    return lax.fori_loop(0, P // PB, fold_block, (m, l, acc))
+
+
 def _ring_body(q, k0, v0, *, axis: str, varying: tuple, n_blocks: int, causal: bool, scale: float,
                prefix=None, prefix_block: int = 1024):
     """Per-device function under shard_map. q/k0/v0: [B, Sblk, H(kv), D].
@@ -53,24 +98,12 @@ def _ring_body(q, k0, v0, *, axis: str, varying: tuple, n_blocks: int, causal: b
         kv_pos = src * Sq + jnp.arange(k_cur.shape[1])
 
         def update(m, l, acc):
-            k_rep = gqa_repeat(k_cur, H)
-            v_rep = gqa_repeat(v_cur, H)
-            logits = jnp.einsum("bqhd,bkhd->bhqk", q32, k_rep.astype(jnp.float32)) * scale
             if causal:
                 invalid = kv_pos[None, None, None, :] > q_pos[None, None, :, None]
-                logits = jnp.where(invalid, _NEG, logits)
             else:
-                invalid = jnp.zeros(logits.shape, bool)
-            m_new = jnp.maximum(m, logits.max(axis=-1))
-            # zero masked probabilities explicitly: a partially-masked block
-            # must contribute nothing under its mask even while m_new = _NEG
-            p = jnp.where(invalid, 0.0, jnp.exp(logits - m_new[..., None]))
-            correction = jnp.exp(m - m_new)
-            l_new = l * correction + p.sum(axis=-1)
-            acc_new = acc * correction[..., None] + jnp.einsum(
-                "bhqk,bkhd->bhqd", p, v_rep.astype(jnp.float32)
-            )
-            return m_new, l_new, acc_new
+                invalid = jnp.zeros((1, 1, 1, k_cur.shape[1]), bool)
+            return online_fold(q32, k_cur, v_cur, m, l, acc,
+                               scale=scale, H=H, invalid=invalid)
 
         if not causal:
             return update(m, l, acc)
@@ -95,34 +128,10 @@ def _ring_body(q, k0, v0, *, axis: str, varying: tuple, n_blocks: int, causal: b
 
     if prefix is not None:
         kp, vp, prefix_len = prefix
-        P = kp.shape[1]
-        PB = min(prefix_block, P)
-
-        while P % PB:  # static: blocks must tile the prefix exactly, or
-            PB -= 1    # the clamped last dynamic_slice would misposition
-
-        def fold_prefix_block(b, carry):
-            m, l, acc = carry
-            k_blk = lax.dynamic_slice_in_dim(kp, b * PB, PB, axis=1)
-            v_blk = lax.dynamic_slice_in_dim(vp, b * PB, PB, axis=1)
-            pos = b * PB + jnp.arange(PB)
-            k_rep = gqa_repeat(k_blk, H)
-            v_rep = gqa_repeat(v_blk, H)
-            logits = jnp.einsum("bqhd,bkhd->bhqk", q32, k_rep.astype(jnp.float32)) * scale
-            invalid = (pos >= prefix_len)[None, None, None, :]
-            logits = jnp.where(invalid, _NEG, logits)
-            m_new = jnp.maximum(m, logits.max(axis=-1))
-            p = jnp.where(invalid, 0.0, jnp.exp(logits - m_new[..., None]))
-            corr = jnp.exp(m - m_new)
-            return (
-                m_new,
-                l * corr + p.sum(axis=-1),
-                acc * corr[..., None] + jnp.einsum(
-                    "bhqk,bkhd->bhqd", p, v_rep.astype(jnp.float32)
-                ),
-            )
-
-        m0, l0, acc0 = lax.fori_loop(0, P // PB, fold_prefix_block, (m0, l0, acc0))
+        m0, l0, acc0 = fold_prefix_blocks(
+            q32, kp, vp, prefix_len, m0, l0, acc0,
+            scale=scale, H=H, prefix_block=prefix_block,
+        )
     # n_blocks-1 steps each ending in a ring hop; the final block is folded
     # in WITHOUT the trailing (discarded) ppermute pair
     m, l, acc, k_last, v_last = lax.fori_loop(

@@ -198,11 +198,12 @@ class EngineConfig:
     # chunked ring prefill: segment size (tokens) for the seq-sharded
     # prefill. > 0 splits a ring-eligible prompt into segments that
     # interleave with decode steps in the scheduler loop (each segment
-    # ring-attends to itself and folds the cached earlier segments —
-    # ops/ring_attention.py ring_attention_with_prefix), so one long
-    # prompt no longer stalls every in-flight stream for its whole
-    # prefill. 0 = monolithic one-shot ring prefill (ulysses sp_mode is
-    # always monolithic). Rounded up to a seq-axis multiple.
+    # SP-attends to itself — ring or Ulysses per sp_mode — and folds the
+    # cached earlier segments: ops/ring_attention.py
+    # ring_attention_with_prefix / ops/ulysses.py
+    # ulysses_attention_with_prefix), so one long prompt no longer stalls
+    # every in-flight stream for its whole prefill. 0 = monolithic
+    # one-shot SP prefill. Rounded up to a seq-axis multiple.
     ring_prefill_chunk: int = 4096
 
 
