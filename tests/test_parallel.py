@@ -801,13 +801,15 @@ def test_70b_shardings_fit_v5p16_mesh_shapes():
     }
     shardings = llama_param_shardings(mesh)
 
-    def check(path, shape, ns):
-        spec = list(ns.spec) + [None] * (len(shape) - len(ns.spec))
+    def check(path, shape, ns, on_mesh=None):
+        on_mesh = on_mesh or mesh
+        parts = ns.spec if hasattr(ns, "spec") else ns  # NamedSharding | P
+        spec = list(parts) + [None] * (len(shape) - len(parts))
         for dim, axes in zip(shape, spec):
             if axes is None:
                 continue
             extent = math.prod(
-                mesh.shape[a] for a in (axes if isinstance(axes, tuple) else (axes,))
+                on_mesh.shape[a] for a in (axes if isinstance(axes, tuple) else (axes,))
             )
             assert dim % extent == 0, f"{path}: dim {dim} !% mesh {axes}={extent}"
 
@@ -819,3 +821,24 @@ def test_70b_shardings_fit_v5p16_mesh_shapes():
 
     # decode-state KV pages: fused Hkv*hd dim divides the model axis
     assert (Hkv * hd) % mesh.shape["model"] == 0
+    # int8-KV scale rows shard head-aligned (Hkv % 8 == 0 at 70B)
+    assert Hkv % 8 == 0
+
+    # r5: the PIPELINE route to 70B — pipe=4 x model=4 on the same 16
+    # chips, with in-stage Megatron TP. Every stage gets a whole number
+    # of layers and every Megatron dim divides the in-stage TP extent.
+    from jax.sharding import PartitionSpec as P
+
+    from finchat_tpu.parallel.pipeline import _pipeline_layer_specs, _stage_tp
+
+    pp_mesh = AbstractMesh(
+        (1, 4, 1, 1, 4), ("data", "pipe", "seq", "expert", "model")
+    )
+    assert L % pp_mesh.shape["pipe"] == 0  # 80 layers / 4 stages
+    tp = _stage_tp(config, pp_mesh)
+    assert tp == 4  # in-stage TP actually engages at 70B shapes
+    specs = _pipeline_layer_specs(shapes["layers"], tp)
+    assert specs["attn_q"] == P("pipe", None, "model")
+    assert specs["mlp_down"] == P("pipe", "model", None)
+    for k, shape in shapes["layers"].items():
+        check(f"pp layers/{k}", shape, specs[k], on_mesh=pp_mesh)
