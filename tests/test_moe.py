@@ -154,3 +154,51 @@ def test_moe_train_step_learns_ep_dp_tp():
         losses.append(float(loss))
     assert all(jnp.isfinite(jnp.asarray(losses))), losses
     assert losses[-1] < losses[0], losses
+
+
+def test_moe_segmented_ring_prefill_matches_monolithic():
+    """MoE composes with the chunked SP prefill (r5): a routed-experts
+    model prefilled in ring segments (prefix fold over the cached
+    earlier segments) must match the one-shot ring prefill — EP + SP +
+    TP in the serving prefill path at once."""
+    from finchat_tpu.engine.engine import InferenceEngine, commit_first_token
+    from finchat_tpu.engine.kv_cache import PageAllocator, pages_needed
+    from finchat_tpu.utils.config import EngineConfig
+
+    config = PRESETS["moe-tiny"]
+    params = init_params(config, jax.random.key(0))
+    prompt = list(np.random.RandomState(3).randint(1, 250, size=100))
+    mesh = build_mesh(MeshSpec(data=1, seq=2, expert=2, model=2))
+    n_new = 5
+
+    def run(ring_chunk):
+        ecfg = EngineConfig(
+            max_seqs=2, page_size=8, num_pages=64, max_seq_len=256,
+            prefill_chunk=16, ring_prefill_min_tokens=16,
+            ring_prefill_chunk=ring_chunk,
+        )
+        eng = InferenceEngine(config, params, ecfg, mesh=mesh)
+        alloc = PageAllocator(ecfg.num_pages)
+        pages = alloc.allocate("s", pages_needed(len(prompt) + n_new, 8))
+        eng.set_page_table_row(0, pages)
+        if ring_chunk:
+            rc = eng.ring_segment_tokens()
+            logits = None
+            for start in range(0, len(prompt), rc):
+                logits = eng.prefill_ring_segment(0, prompt[start : start + rc], start)
+        else:
+            logits = eng.prefill_ring(0, prompt)
+        eng.state, tok = commit_first_token(
+            eng.state, jnp.int32(0), logits, jnp.float32(0.0), jnp.float32(1.0), jnp.int32(0)
+        )
+        out = [int(tok)]
+        active = jnp.zeros((2,), bool).at[0].set(True)
+        z, o, zk = jnp.zeros((2,)), jnp.ones((2,)), jnp.zeros((2,), jnp.int32)
+        for _ in range(n_new - 1):
+            out.append(int(eng.decode(active, z, o, zk)[0]))
+        return np.asarray(logits, np.float32), out
+
+    mono_logits, mono_tokens = run(0)
+    seg_logits, seg_tokens = run(32)  # 100 tokens -> 4 segments
+    np.testing.assert_allclose(seg_logits, mono_logits, atol=2e-2, rtol=2e-2)
+    assert seg_tokens == mono_tokens
