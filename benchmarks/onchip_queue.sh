@@ -4,7 +4,9 @@
 # Captures, in priority order (VERDICT r4 next-round items 3, 1, 2):
 #   1. PALLAS_ONCHIP_r05.json — 11-test interpret=False kernel parity
 #   2. BENCH_8B_r05.json      — llama3-8b int8+int8KV decode headline
-#   3. TTFT_r05_tpu*.json     — 64-session load, plain vs shared-prefix
+#   3. TTFT_r05_tpu*.json     — 64-session load: herd plain, herd
+#      shared-prefix, and steady-state (2 qps Poisson — the workload the
+#      300 ms p50 target physically applies to; see PERF_r05.md)
 #
 # Each step is independently re-runnable and failure-recording; a wedged
 # tunnel mid-queue leaves earlier artifacts intact. Serial on purpose —
@@ -23,26 +25,33 @@ if ! probe; then
 fi
 echo "[queue] tunnel LIVE" >&2
 
-echo "[queue] 1/4 pallas on-chip parity" >&2
+echo "[queue] 1/5 pallas on-chip parity" >&2
 python benchmarks/pallas_onchip.py PALLAS_ONCHIP_r05.json || true
 
-echo "[queue] 2/4 llama3-8b int8 headline bench" >&2
+echo "[queue] 2/5 llama3-8b int8 headline bench" >&2
 timeout 3000 python bench.py --preset llama3-8b --quant int8 --kv-quant int8 \
   > BENCH_8B_r05.json 2> BENCH_8B_r05.log || true
 tail -1 BENCH_8B_r05.json || true
 
-echo "[queue] 3/4 TTFT 64 sessions (llama3-8b int8), plain" >&2
+echo "[queue] 3/5 TTFT 64 sessions (llama3-8b int8), plain" >&2
 timeout 2400 python benchmarks/load_harness.py --preset llama3-8b \
   --quant int8 --kv-quant int8 --sessions 64 \
   --prompt-len 4096 --new-tokens 64 --shared-prefix 0 \
   > TTFT_r05_tpu.json 2> TTFT_r05_tpu.log || true
 tail -1 TTFT_r05_tpu.json || true
 
-echo "[queue] 4/4 TTFT 64 sessions (llama3-8b int8), shared 3k head" >&2
+echo "[queue] 4/5 TTFT 64 sessions (llama3-8b int8), shared 3k head" >&2
 timeout 2400 python benchmarks/load_harness.py --preset llama3-8b \
   --quant int8 --kv-quant int8 --sessions 64 \
   --prompt-len 4096 --new-tokens 64 --shared-prefix 3072 \
   > TTFT_r05_tpu_prefix.json 2> TTFT_r05_tpu_prefix.log || true
 tail -1 TTFT_r05_tpu_prefix.json || true
+
+echo "[queue] 5/5 TTFT steady-state (llama3-8b int8, 2 qps, shared head)" >&2
+timeout 2400 python benchmarks/load_harness.py --preset llama3-8b \
+  --quant int8 --kv-quant int8 --sessions 64 --arrival-qps 2 \
+  --prompt-len 4096 --new-tokens 64 --shared-prefix 3072 \
+  > TTFT_r05_tpu_steady.json 2> TTFT_r05_tpu_steady.log || true
+tail -1 TTFT_r05_tpu_steady.json || true
 
 echo "[queue] done — artifacts: PALLAS_ONCHIP_r05.json BENCH_8B_r05.json TTFT_r05_tpu*.json" >&2
