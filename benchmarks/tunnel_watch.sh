@@ -1,0 +1,15 @@
+#!/bin/bash
+# Probe the axon tunnel every 10 min; the moment it answers, run the
+# round-5 on-chip capture queue ONCE, then exit. Single-tenant: while
+# this watcher runs, nothing else should touch the TPU.
+cd "$(dirname "$0")/.."
+while true; do
+  if timeout 100 python -c "import jax, jax.numpy as jnp; print((jnp.ones((256,256),jnp.bfloat16)@jnp.ones((256,256),jnp.bfloat16))[0,0])" >/dev/null 2>&1; then
+    echo "[watch] $(date -u +%H:%M:%S) tunnel LIVE — running capture queue" >> tunnel_watch.log
+    bash benchmarks/onchip_queue.sh >> tunnel_watch.log 2>&1
+    echo "[watch] queue finished rc=$?" >> tunnel_watch.log
+    break
+  fi
+  echo "[watch] $(date -u +%H:%M:%S) wedged" >> tunnel_watch.log
+  sleep 600
+done
