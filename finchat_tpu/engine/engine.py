@@ -273,7 +273,7 @@ def _ring_segment_attention_fn(mesh, page_table: Array, prefix_pages: int,
     losing cross-segment attention."""
 
     def attention(q: Array, k: Array, v: Array, cache: Any, layer_idx: Array):
-        from finchat_tpu.engine.kv_cache import gather_kv, gather_kv_q8
+        from finchat_tpu.engine.kv_cache import gather_kv_any
         if sp_mode == "ulysses":
             from finchat_tpu.ops.ulysses import (
                 ulysses_attention_with_prefix as attn_with_prefix,
@@ -284,20 +284,15 @@ def _ring_segment_attention_fn(mesh, page_table: Array, prefix_pages: int,
             )
 
         k_pages, v_pages, k_scales, v_scales = cache
-        quantized = k_pages.dtype == jnp.int8
         lay = jnp.asarray(layer_idx, jnp.int32).reshape(())
         # the GATHER is bounded to the static prefix-page bucket (folding
         # max_pages every segment would cost O(segments x max_seq_len));
         # the SCATTER below keeps the full row — the segment's own pages
         # lie past the prefix
-        gather_row = page_table[:, :prefix_pages]
-        if quantized:
-            kp, vp = gather_kv_q8(
-                k_pages, v_pages, k_scales, v_scales, gather_row, page_size,
-                lay, n_kv, dtype=q.dtype,
-            )
-        else:
-            kp, vp = gather_kv(k_pages, v_pages, gather_row, page_size, lay, n_kv)
+        kp, vp = gather_kv_any(
+            k_pages, v_pages, k_scales, v_scales,
+            page_table[:, :prefix_pages], page_size, lay, n_kv, dtype=q.dtype,
+        )
         out = attn_with_prefix(
             q, k, v, kp, vp, start_pos[0],
             mesh=mesh, axis="seq", head_axis="model", causal=True,

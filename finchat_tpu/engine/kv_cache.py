@@ -287,6 +287,28 @@ def scatter_kv_chunk_q8(
     return k_pages, v_pages, k_scales, v_scales
 
 
+def gather_kv_any(
+    k_pages: Any,
+    v_pages: Any,
+    k_scales: Any,
+    v_scales: Any,
+    page_table: Any,
+    page_size: int,
+    layer: Any,
+    n_kv: int,
+    dtype: Any = jnp.bfloat16,
+) -> tuple[Any, Any]:
+    """``gather_kv`` dispatching on the cache dtype — the ONE place the
+    int8-vs-native READ choice lives for the jnp gather paths (the
+    reference attention backend and the SP-segment prefix fold)."""
+    if k_pages.dtype == jnp.int8:
+        return gather_kv_q8(
+            k_pages, v_pages, k_scales, v_scales, page_table, page_size,
+            layer, n_kv, dtype=dtype,
+        )
+    return gather_kv(k_pages, v_pages, page_table, page_size, layer, n_kv)
+
+
 def gather_kv_q8(
     k_pages: Any,  # [L, P, page_size, Hkv*hd] int8
     v_pages: Any,

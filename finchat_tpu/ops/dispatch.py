@@ -63,19 +63,14 @@ def paged_attention(
     if quantized:
         assert k_scales is not None and v_scales is not None
     if backend == "ref":
-        from finchat_tpu.engine.kv_cache import gather_kv, gather_kv_q8
+        from finchat_tpu.engine.kv_cache import gather_kv_any
         from finchat_tpu.ops.refs import mha_reference
 
         lay = jnp.asarray(layer, jnp.int32).reshape(())
-        if quantized:
-            k_all, v_all = gather_kv_q8(
-                k_pages, v_pages, k_scales, v_scales, page_table, page_size,
-                lay, n_kv, dtype=q.dtype,
-            )
-        else:
-            k_all, v_all = gather_kv(
-                k_pages, v_pages, page_table, page_size, lay, n_kv,
-            )
+        k_all, v_all = gather_kv_any(
+            k_pages, v_pages, k_scales, v_scales, page_table, page_size,
+            lay, n_kv, dtype=q.dtype,
+        )
         return mha_reference(
             q, k_all, v_all, causal=True, q_offset=q_offset, kv_len=kv_len
         )
