@@ -360,6 +360,68 @@ def test_chunked_registration_interleaves_with_decode():
     assert asyncio.run(collect_chunked()) == golden
 
 
+def test_app_rollover_refresh_is_chunked_and_nonblocking():
+    """VERDICT r4 weak #6 'done' criterion, app level: a date rollover
+    (prompt heads change) picked up by the app's periodic checker retires
+    the stale head and registers the fresh one through the CHUNKED path —
+    and a concurrent stream keeps receiving tokens during the refresh."""
+    from types import SimpleNamespace
+
+    from finchat_tpu.serve.app import (
+        _maybe_refresh_prefix_cache,
+        register_prompt_prefixes,
+    )
+
+    tok, scheduler = _make_scheduler()
+    old_head = (HEAD + " v1 ") * 2
+    new_head = (HEAD + " v2 ") * 2
+    heads = [old_head]
+
+    agent = SimpleNamespace(
+        prompt_heads=lambda: list(heads),
+        tool_generator=SimpleNamespace(tokenizer=tok),
+    )
+    app = SimpleNamespace(
+        _prefix_cache_enabled=True,
+        scheduler=scheduler,
+        agent=agent,
+        _registered_heads=register_prompt_prefixes(agent, scheduler, tok),
+    )
+    assert app._registered_heads == {old_head}
+
+    async def run():
+        await scheduler.start()
+        try:
+            stream = await scheduler.submit(
+                "stream", tok.encode("hello there", add_bos=True),
+                SamplingParams(temperature=0.0, max_new_tokens=64),
+            )
+            seen = 0
+            while seen < 4:  # steady-state decode first
+                event = await asyncio.wait_for(stream.events.get(), timeout=120)
+                assert event["type"] == "token", event
+                seen += 1
+            heads[:] = [new_head]  # midnight: the rendered head changes
+            refresh = asyncio.create_task(_maybe_refresh_prefix_cache(app))
+            during = 0
+            while not refresh.done():
+                event = await asyncio.wait_for(stream.events.get(), timeout=120)
+                if event["type"] != "token":
+                    break
+                during += 1
+            await refresh
+            return during
+        finally:
+            await scheduler.stop()
+
+    during = asyncio.run(run())
+    assert app._registered_heads == {new_head}
+    # the fresh head matches; the stale one no longer does
+    assert scheduler._match_prefix(tok.encode(new_head + "x", add_bos=True))[1] > 0
+    assert scheduler._match_prefix(tok.encode(old_head + "x", add_bos=True)) == (None, 0)
+    assert during >= 2, f"stream starved during rollover refresh ({during} tokens)"
+
+
 def test_match_leaves_at_least_one_token_to_prefill():
     tok, scheduler = _make_scheduler()
     ids = tok.encode(HEAD, add_bos=True)
