@@ -138,10 +138,12 @@ async def run_load(
     finally:
         await scheduler.stop()
     wall = time.perf_counter() - t_all0
-    # the throughput denominator must not count the arrival ramp (the
-    # batch is mostly idle while sessions trickle in): clock from the
-    # last arrival, when the offered load is fully present
-    busy_wall = max(wall - float(delays.max()), 1e-9)
+    # throughput over the FULL wall, ramp included. Subtracting the
+    # arrival ramp would be wrong the other way: tokens emitted DURING
+    # the ramp stay in the numerator, so a shrunken denominator inflates
+    # the figure (several-fold at low qps). Full-wall understates
+    # steady-state slightly and is the conservative, comparable choice;
+    # for the herd (qps=0) the two coincide.
 
     total_tokens = sum(tokens_out)
     ttfts_a = np.asarray(ttfts)
@@ -154,7 +156,7 @@ async def run_load(
         "vs_baseline": round(BASELINE_TTFT_P50_S / max(p50, 1e-9), 3),  # >1 = better
         "ttft_p95_s": round(float(np.nanpercentile(ttfts_a, 95)), 4) if failed < len(ttfts) else float("nan"),
         "failed_sessions": failed,
-        "throughput_tok_s": round(total_tokens / busy_wall, 1),
+        "throughput_tok_s": round(total_tokens / wall, 1),
         "sessions": sessions,
         "prompt_len": prompt_len,
         "new_tokens": new_tokens,
