@@ -40,10 +40,11 @@ async def run_load(
     page_size: int, prefill_chunk: int, shared_prefix: int = 0,
     spec_tokens: int = 0, temperature: float = 0.5,
     quant: str = "", kv_quant: str = "",
-    arrival_qps: float = 0.0,
+    arrival_qps: float = 0.0, kv_budget_gb: float = 0.0,
 ) -> dict:
     from finchat_tpu.engine.engine import InferenceEngine
     from finchat_tpu.engine.generator import EngineGenerator
+    from finchat_tpu.engine.kv_cache import page_hbm_bytes
     from finchat_tpu.engine.sampler import SamplingParams
     from finchat_tpu.engine.scheduler import ContinuousBatchingScheduler
     from finchat_tpu.models.llama import PRESETS, init_params
@@ -53,10 +54,29 @@ async def run_load(
     config = PRESETS[preset]
     max_len = prompt_len + new_tokens
     pages_per_seq = -(-max_len // page_size)
+    num_pages = sessions * pages_per_seq + 8
+    if kv_budget_gb > 0:
+        # Fit the pool to an HBM budget instead of sessions x pages: at the
+        # north-star shape (llama3-8b int8, 64 x 4k sessions) all-resident
+        # KV would be ~17 GB against a 16 GB v5e — the paged admission
+        # scheduler exists precisely so the pool can be smaller than the
+        # offered load (excess sessions queue; the prefix cache makes the
+        # shared head free so the 64 fit when it's registered).
+        cap = int(kv_budget_gb * (1 << 30)) // page_hbm_bytes(
+            config, page_size, kv_quant
+        )
+        # floor: one full sequence + the trash page + one spare page so
+        # admission can always make progress
+        cap = max(cap, pages_per_seq + 2)
+        if cap < num_pages:
+            print(f"[load] KV pool capped to {cap} pages "
+                  f"({kv_budget_gb} GB budget; uncapped would be "
+                  f"{num_pages})", file=sys.stderr)
+            num_pages = cap
     engine_cfg = EngineConfig(
         max_seqs=sessions,
         page_size=page_size,
-        num_pages=sessions * pages_per_seq + 8,
+        num_pages=num_pages,
         max_seq_len=max_len,
         prefill_chunk=prefill_chunk,
         max_new_tokens=new_tokens,
@@ -171,6 +191,8 @@ async def run_load(
         "quant": quant or "bf16",
         "kv_quant": kv_quant or "off",
         "arrival_qps": arrival_qps,  # 0 = thundering herd
+        "num_pages": num_pages,
+        "kv_budget_gb": kv_budget_gb,
         "model": preset,
         "platform": jax.devices()[0].platform,
     }
@@ -206,6 +228,10 @@ def main() -> None:
     p.add_argument("--arrival-qps", type=float, default=0.0,
                    help="Poisson session arrival rate (steady-state TTFT); "
                         "0 = all sessions at once (thundering herd)")
+    p.add_argument("--kv-budget-gb", type=float, default=0.0,
+                   help="cap the KV page pool to this many GB of HBM "
+                        "(excess sessions queue via paged admission); "
+                        "0 = size for all sessions resident")
     args = p.parse_args()
     result = asyncio.run(
         run_load(
@@ -213,7 +239,7 @@ def main() -> None:
             args.page_size, args.prefill_chunk, args.shared_prefix,
             args.spec_tokens, args.temperature,
             args.quant or "", args.kv_quant or "",
-            args.arrival_qps,
+            args.arrival_qps, args.kv_budget_gb,
         )
     )
     print(json.dumps(result))

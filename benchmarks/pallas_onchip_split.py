@@ -72,13 +72,15 @@ def main() -> int:
         print(json.dumps({"rc": -1, "error": reason}))
         return 1
 
+    # carry forward EVERY prior record (a failure from an earlier window is
+    # evidence that must survive later interrupted windows); only passed
+    # nodes are skipped on re-run, and a re-run node replaces its entry
     prior: dict[str, dict] = {}
     if os.path.exists(args.out):
         try:
             with open(args.out) as f:
                 rec = json.load(f)
-            prior = {t["node"]: t for t in rec.get("tests_detail", [])
-                     if t.get("status") == "passed"}
+            prior = {t["node"]: t for t in rec.get("tests_detail", [])}
         except Exception:
             prior = {}
 
@@ -87,16 +89,18 @@ def main() -> int:
     except (RuntimeError, subprocess.TimeoutExpired) as e:
         return write_failure(f"test collection failed: {e}")
 
-    results: list[dict] = []
+    results: dict[str, dict] = {}
 
     def write_record() -> dict:
-        detail = [prior[n] for n in nodes if n in prior] + results
+        merged = {**prior, **results}  # re-run nodes replace prior entries
+        detail = [merged[n] for n in nodes if n in merged]
         passed_nodes = {t["node"] for t in detail if t["status"] == "passed"}
         statuses = [t["status"] for t in detail]
         record = {
             "artifact": "pallas_onchip_parity",
             "mode": "per-test",
             "interpret": False,
+            "platform": "tpu",  # enforced per-node by FINCHAT_REQUIRE_TPU
             # success requires the full collected matrix, not just the
             # subset that happened to run before an interruption
             "rc": 0 if passed_nodes >= set(nodes) else 1,
@@ -113,10 +117,14 @@ def main() -> int:
             f.write("\n")
         return record
 
-    env = {**os.environ, "FINCHAT_TESTS_TPU": "1"}
+    # FINCHAT_REQUIRE_TPU: tests/conftest.py hard-fails the node if the
+    # backend silently resolves to CPU (fast-failing tunnel init would
+    # otherwise run the matrix interpret=True on CPU and record a false
+    # on-chip pass)
+    env = {**os.environ, "FINCHAT_TESTS_TPU": "1", "FINCHAT_REQUIRE_TPU": "1"}
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     for node in nodes:
-        if node in prior:
+        if prior.get(node, {}).get("status") == "passed":
             print(f"[split] SKIP (already passed): {node}", file=sys.stderr)
             continue
         print(f"[split] RUN {node}", file=sys.stderr, flush=True)
@@ -134,13 +142,13 @@ def main() -> int:
                 status = "passed"
             else:
                 status = "failed"
-            results.append({"node": node, "status": status,
-                            "duration_s": round(dur, 1),
-                            "summary": summary[:200]})
+            results[node] = {"node": node, "status": status,
+                             "duration_s": round(dur, 1),
+                             "summary": summary[:200]}
         except subprocess.TimeoutExpired:
-            results.append({"node": node, "status": "timeout",
-                            "duration_s": round(args.per_test_timeout, 1),
-                            "summary": "per-test timeout (tunnel wedge suspect)"})
+            results[node] = {"node": node, "status": "timeout",
+                             "duration_s": round(args.per_test_timeout, 1),
+                             "summary": "per-test timeout (tunnel wedge suspect)"}
             write_record()
             # A timeout here usually means the tunnel is gone; probing again
             # with more compiles just burns the window. Stop.

@@ -6,33 +6,18 @@
 # Loops (rather than exiting after one queue run) because the tunnel has
 # been observed to give SHORT live windows: a queue aborted mid-way by a
 # re-wedge resumes capturing on the next window (the queue skips steps
-# whose artifacts already validate). Exits only when EVERY artifact the
-# queue produces is captured — the four "platform": "tpu" JSONs plus a
-# complete (rc==0) Pallas parity matrix — or after 24 h.
+# whose artifacts already validate, and exits 0 only when EVERY artifact
+# is captured — the queue owns the artifact list and validity rules).
+# The queue's leading guard doubles as the tunnel probe: when wedged and
+# artifacts are missing it exits 1 after one ~100 s probe; when all
+# artifacts validate it exits 0 without touching the tunnel at all.
 cd "$(dirname "$0")/.."
-all_captured() {
-  for f in BENCH_8B_r05.json TTFT_r05_tpu_steady.json \
-           TTFT_r05_tpu_prefix.json TTFT_r05_tpu.json; do
-    grep -q '"platform": "tpu"' "$f" 2>/dev/null || return 1
-  done
-  grep -q '"rc": 0' PALLAS_ONCHIP_r05.json 2>/dev/null
-}
 deadline=$(( $(date +%s) + 86400 ))
 while [ "$(date +%s)" -lt "$deadline" ]; do
-  if all_captured; then
-    echo "[watch] all artifacts already captured — done" >> tunnel_watch.log
+  echo "[watch] $(date -u +%H:%M:%S) running capture queue" >> tunnel_watch.log
+  if bash benchmarks/onchip_queue.sh >> tunnel_watch.log 2>&1; then
+    echo "[watch] all artifacts captured — done" >> tunnel_watch.log
     break
-  fi
-  if timeout 100 python -c "import jax, jax.numpy as jnp; print((jnp.ones((256,256),jnp.bfloat16)@jnp.ones((256,256),jnp.bfloat16))[0,0])" >/dev/null 2>&1; then
-    echo "[watch] $(date -u +%H:%M:%S) tunnel LIVE — running capture queue" >> tunnel_watch.log
-    bash benchmarks/onchip_queue.sh >> tunnel_watch.log 2>&1
-    echo "[watch] queue finished rc=$?" >> tunnel_watch.log
-    if all_captured; then
-      echo "[watch] all artifacts captured — done" >> tunnel_watch.log
-      break
-    fi
-  else
-    echo "[watch] $(date -u +%H:%M:%S) wedged" >> tunnel_watch.log
   fi
   sleep 300
 done

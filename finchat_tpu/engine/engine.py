@@ -35,7 +35,7 @@ from finchat_tpu.engine.kv_cache import (
     scatter_kv_chunk,
 )
 from finchat_tpu.engine.sampler import sample
-from finchat_tpu.models.llama import LlamaConfig, forward
+from finchat_tpu.models.llama import LlamaConfig, forward, lm_head
 from finchat_tpu.ops.dispatch import paged_attention
 from finchat_tpu.utils.config import EngineConfig
 from finchat_tpu.utils.logging import get_logger
@@ -185,14 +185,19 @@ def prefill_step(
     attention = _paged_attention_fn(
         page_rows, start_pos, n_valid, page_size, config.n_kv_heads, attn_backend
     )
-    logits, (k_pages, v_pages, k_scales, v_scales) = forward(
+    # hidden states only, then project just each sequence's last valid row:
+    # full-chunk fp32 logits would be [N, C, vocab] — 4.2 GB for the 8B
+    # bench shape (64 x 128 x 128256) — vs 33 MB for [N, vocab]
+    hidden, (k_pages, v_pages, k_scales, v_scales) = forward(
         params, tokens, positions,
         config=config, attention=attention,
         cache=(state.k_pages, state.v_pages, state.k_scales, state.v_scales),
+        return_hidden=True,
     )
-    last_logits = jnp.take_along_axis(
-        logits, jnp.maximum(n_valid - 1, 0)[:, None, None], axis=1
-    )[:, 0]  # [N, vocab]
+    last_hidden = jnp.take_along_axis(
+        hidden, jnp.maximum(n_valid - 1, 0)[:, None, None], axis=1
+    )[:, 0]  # [N, D]
+    last_logits = lm_head(params, last_hidden, config=config)  # [N, vocab]
 
     new_state = dataclasses.replace(
         state,
@@ -336,8 +341,6 @@ def ring_prefill_segment_step(
     without it every segment would dequantize and fold max_seq_len
     positions per layer, costing O(segments x max_seq_len) attention
     instead of the monolithic path's O(S^2/2)."""
-    from finchat_tpu.models.llama import lm_head
-
     S = tokens.shape[1]
     positions = start_pos + jnp.arange(S)[None, :]  # RoPE is absolute
     page_row = jax.lax.dynamic_slice_in_dim(state.page_table, slot, 1, axis=0)
@@ -401,8 +404,6 @@ def ring_prefill_step(
     # hidden states only — a full [S, vocab] fp32 logits tensor at long-S
     # would cost GBs in exactly the regime this path exists for; project
     # the single last-valid row instead
-    from finchat_tpu.models.llama import lm_head
-
     hidden, (k_pages, v_pages, k_scales, v_scales) = forward(
         params, tokens, positions,
         config=config, attention=attention,

@@ -109,6 +109,22 @@ class PagedKVCache:
                 + self.k_scales.nbytes + self.v_scales.nbytes)
 
 
+def page_hbm_bytes(config: LlamaConfig, page_size: int, kv_quant: str = "") -> int:
+    """HBM bytes ONE page costs across all layers (K+V, plus the int8
+    scale rows) — computed WITHOUT allocating, so harnesses can fit a KV
+    pool to an HBM budget before engine construction. Mirrors
+    ``PagedKVCache.create``'s shapes exactly (asserted in
+    tests/test_kv_cache.py)."""
+    import numpy as np
+
+    row = config.n_kv_heads * config.head_dim
+    itemsize = 1 if kv_quant else np.dtype(config.dtype).itemsize
+    per = 2 * config.n_layers * page_size * row * itemsize
+    if kv_quant:
+        per += 2 * config.n_layers * scale_rows(config.n_kv_heads) * page_size * 4
+    return per
+
+
 class PageAllocationError(RuntimeError):
     pass
 

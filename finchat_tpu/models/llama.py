@@ -124,7 +124,16 @@ def init_params(
     tf = leaf_transform or (lambda name, x: x)
 
     def rand_init(name: str, k: Array, shape: tuple[int, ...], fan_in: int) -> Array:
-        return tf(name, (jax.random.normal(k, shape, jnp.float32) * fan_in ** -0.5).astype(c.dtype))
+        # Large leaves generate directly in the model dtype: the fp32
+        # intermediate for a stacked 8B leaf (mlp_down [32,14336,4096] =
+        # 7.5 GB) plus the already-materialized quantized leaves would
+        # overflow one v5e chip's 16 GB HBM during init_quantized init.
+        # Small (test-preset) leaves keep the fp32->cast path so pinned
+        # golden decode sequences are unchanged.
+        import math
+
+        gen_dtype = c.dtype if math.prod(shape) > (1 << 28) else jnp.float32
+        return tf(name, (jax.random.normal(k, shape, gen_dtype) * fan_in ** -0.5).astype(c.dtype))
 
     keys = jax.random.split(k_layers, 8)
     L, D, H, Hkv, hd, F = c.n_layers, c.dim, c.n_heads, c.n_kv_heads, c.head_dim, c.hidden_dim

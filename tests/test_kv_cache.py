@@ -101,3 +101,22 @@ def test_scatter_padding_goes_to_trash():
     assert float(k_pages[0, 1, :2].sum()) == 2 * Hkv * hd
     assert float(k_pages[0, 1, 2:].sum()) == 0.0
     assert float(k_pages[0, 2].sum()) == 0.0
+
+
+def test_page_hbm_bytes_matches_real_allocation():
+    """page_hbm_bytes (the no-alloc sizing helper harnesses use to fit a
+    KV pool to an HBM budget) must mirror PagedKVCache.create exactly,
+    for both the native-dtype and int8 layouts."""
+    from finchat_tpu.engine.kv_cache import PagedKVCache, page_hbm_bytes
+    from finchat_tpu.models.llama import PRESETS
+
+    config = PRESETS["mini"]
+    for kv_quant in ("", "int8"):
+        cache = PagedKVCache.create(config, num_pages=6, page_size=16,
+                                    kv_quant=kv_quant)
+        per_page = page_hbm_bytes(config, 16, kv_quant)
+        expected = per_page * 6
+        if not kv_quant:
+            # the no-quant layout carries (1,1,1,1) scale placeholders
+            expected += cache.k_scales.nbytes + cache.v_scales.nbytes
+        assert cache.hbm_bytes() == expected
