@@ -97,10 +97,10 @@ else
     --per-test-timeout 420 || true
 fi
 
-# Exit 0 ONLY when every artifact is captured — the watcher keys on this
-# (single source of truth for the artifact list and validity rules; when
-# everything already validates the capture steps all SKIP, so a rc-0 run
-# never touches the tunnel).
+# Exit 0 ONLY when every CORE artifact is captured — the watcher keys on
+# this (single source of truth for the artifact list and validity rules;
+# once everything validates the capture steps all SKIP, so a rc-0 run
+# touches the tunnel only for the opportunistic step below).
 for f in BENCH_8B_r05.json TTFT_r05_tpu_steady.json \
          TTFT_r05_tpu_prefix.json TTFT_r05_tpu.json; do
   if ! valid "$f"; then
@@ -112,5 +112,16 @@ if ! grep -q '"rc": 0' PALLAS_ONCHIP_r05.json 2>/dev/null; then
   echo "[queue] incomplete: PALLAS_ONCHIP_r05.json" >&2
   exit 1
 fi
-echo "[queue] ALL artifacts captured: BENCH_8B_r05.json TTFT_r05_tpu*.json PALLAS_ONCHIP_r05.json" >&2
+
+# Opportunistic, NON-gating (runs only once the core set is complete; the
+# subshell confines guard's wedged-probe `exit 1` so it cannot flip the
+# queue's rc): the on-chip speculative verify-step envelope (VERDICT r4
+# next #4's device-cost half; acceptance on RAG traffic is the CPU
+# replay datum in PERF_r05.md).
+( capture "6/6 llama3-8b int8 spec verify envelope (opportunistic)" BENCH_8B_SPEC_r05.json 2000 \
+    python bench.py --platform tpu --preset llama3-8b \
+    --quant int8 --kv-quant int8 --spec-tokens 3 \
+    --tpu-timeout 240 --measure-budget 1500 ) || true
+
+echo "[queue] ALL core artifacts captured: BENCH_8B_r05.json TTFT_r05_tpu*.json PALLAS_ONCHIP_r05.json" >&2
 exit 0

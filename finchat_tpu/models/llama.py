@@ -103,6 +103,16 @@ def n_params(config: LlamaConfig) -> int:
     return total
 
 
+# Leaves with more elements than this random-init directly in the model
+# dtype instead of fp32-then-cast: the fp32 intermediate for a stacked 8B
+# leaf (mlp_down [32,14336,4096] = 7.5 GB) plus the already-materialized
+# quantized leaves would overflow one v5e chip's 16 GB HBM during
+# init_quantized init. Small (test-preset) leaves keep the fp32->cast
+# path so pinned golden decode sequences are unchanged. Module-level so
+# tests can patch it to exercise the large-leaf branch at small shapes.
+FP32_INIT_MAX_ELEMS = 1 << 28
+
+
 def init_params(
     config: LlamaConfig, key: Array, leaf_transform: Any = None
 ) -> dict[str, Any]:
@@ -124,15 +134,10 @@ def init_params(
     tf = leaf_transform or (lambda name, x: x)
 
     def rand_init(name: str, k: Array, shape: tuple[int, ...], fan_in: int) -> Array:
-        # Large leaves generate directly in the model dtype: the fp32
-        # intermediate for a stacked 8B leaf (mlp_down [32,14336,4096] =
-        # 7.5 GB) plus the already-materialized quantized leaves would
-        # overflow one v5e chip's 16 GB HBM during init_quantized init.
-        # Small (test-preset) leaves keep the fp32->cast path so pinned
-        # golden decode sequences are unchanged.
         import math
 
-        gen_dtype = c.dtype if math.prod(shape) > (1 << 28) else jnp.float32
+        # see FP32_INIT_MAX_ELEMS: large leaves skip the fp32 intermediate
+        gen_dtype = c.dtype if math.prod(shape) > FP32_INIT_MAX_ELEMS else jnp.float32
         return tf(name, (jax.random.normal(k, shape, gen_dtype) * fan_in ** -0.5).astype(c.dtype))
 
     keys = jax.random.split(k_layers, 8)

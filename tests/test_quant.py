@@ -201,3 +201,34 @@ def test_tp_quantized_engine_matches_unsharded():
     sharded = _engine_greedy(
         InferenceEngine(config, params, ecfg, mesh=tp_mesh, quant="int8"), prompt, n_new)
     assert unsharded == sharded
+
+
+def test_large_leaf_init_skips_fp32_intermediate(monkeypatch):
+    """Leaves above FP32_INIT_MAX_ELEMS random-init directly in the model
+    dtype (the 8B-on-one-chip HBM fix); patching the threshold to 0
+    exercises that branch at test shapes. The branch must produce leaves
+    of the same shapes/dtypes and compose with streaming quantization —
+    values legitimately differ from the fp32-path init (different
+    rounding), which is why the threshold exists instead of switching
+    generation dtype globally."""
+    import finchat_tpu.models.llama as llama_mod
+    from finchat_tpu.models.quant import QTensor, init_quantized_llama_params
+
+    config = PRESETS["mini"]
+    baseline = init_params(config, jax.random.key(0))
+
+    monkeypatch.setattr(llama_mod, "FP32_INIT_MAX_ELEMS", 0)
+    large_path = init_params(config, jax.random.key(0))
+    flat_base, tree_base = jax.tree_util.tree_flatten(baseline)
+    flat_large, tree_large = jax.tree_util.tree_flatten(large_path)
+    assert tree_base == tree_large
+    for a, b in zip(flat_base, flat_large):
+        assert a.shape == b.shape and a.dtype == b.dtype
+    # generated values stay finite and correctly scaled (fan-in ~ O(1) std)
+    q = np.asarray(large_path["layers"]["attn_q"], np.float32)
+    assert np.isfinite(q).all() and 0.001 < q.std() < 1.0
+
+    # the streaming quantized init rides the same branch
+    streamed = init_quantized_llama_params(config, jax.random.key(0))
+    assert isinstance(streamed["layers"]["attn_q"], QTensor)
+    assert streamed["layers"]["attn_q"].q.dtype == jnp.int8
