@@ -24,6 +24,15 @@
 set -u
 cd "$(dirname "$0")/.."
 
+# Persistent XLA compilation cache: the wedge gives SHORT windows, and
+# every capture step is a fresh process that would otherwise recompile
+# its whole variant set (~5-10 min of an 8B window). With the cache, a
+# window lost mid-step costs only that step's MEASUREMENT time on retry.
+# If the axon PJRT plugin can't serialize executables jax just logs a
+# warning and proceeds — strictly better, never worse.
+export JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache"
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=2
+
 probe() {
   timeout 100 python -c "import jax, jax.numpy as jnp; print((jnp.ones((256,256),jnp.bfloat16)@jnp.ones((256,256),jnp.bfloat16))[0,0])" >/dev/null 2>&1
 }
@@ -75,17 +84,17 @@ capture "1/5 llama3-8b int8 headline bench" BENCH_8B_r05.json 2000 \
 capture "2/5 TTFT steady-state (llama3-8b int8, 2 qps, shared head)" TTFT_r05_tpu_steady.json 2400 \
   python benchmarks/load_harness.py --preset llama3-8b \
   --quant int8 --kv-quant int8 --sessions 64 --kv-budget-gb 5.5 --arrival-qps 2 \
-  --prompt-len 4096 --new-tokens 64 --shared-prefix 3072
+  --prefill-chunk 512 --prompt-len 4096 --new-tokens 64 --shared-prefix 3072
 
 capture "3/5 TTFT 64-session herd (llama3-8b int8), shared 3k head" TTFT_r05_tpu_prefix.json 2400 \
   python benchmarks/load_harness.py --preset llama3-8b \
   --quant int8 --kv-quant int8 --sessions 64 --kv-budget-gb 5.5 \
-  --prompt-len 4096 --new-tokens 64 --shared-prefix 3072
+  --prefill-chunk 512 --prompt-len 4096 --new-tokens 64 --shared-prefix 3072
 
 capture "4/5 TTFT 64-session herd (llama3-8b int8), plain" TTFT_r05_tpu.json 2400 \
   python benchmarks/load_harness.py --preset llama3-8b \
   --quant int8 --kv-quant int8 --sessions 64 --kv-budget-gb 5.5 \
-  --prompt-len 4096 --new-tokens 64 --shared-prefix 0
+  --prefill-chunk 512 --prompt-len 4096 --new-tokens 64 --shared-prefix 0
 
 # Step 5 manages its own artifact (incremental per-test record, resumes
 # across windows, never reports rc=0 on a partial matrix).
