@@ -13,11 +13,26 @@
 # artifacts validate it exits 0 without touching the tunnel at all.
 cd "$(dirname "$0")/.."
 deadline=$(( $(date +%s) + 86400 ))
+# Exponential backoff between wedged probes (300 s -> 1800 s cap): the one
+# observed recovery (2026-07-31 03:47) came ~80 min after all probing
+# STOPPED, while 10+ h of continuous 10-min probing saw none — killed
+# probe clients may leave server-side claims that delay recovery, so when
+# the tunnel looks wedged we probe LESS often, and reset to the fast
+# cadence the moment a queue run makes progress.
+backoff=300
 while [ "$(date +%s)" -lt "$deadline" ]; do
   echo "[watch] $(date -u +%H:%M:%S) running capture queue" >> tunnel_watch.log
   if bash benchmarks/onchip_queue.sh >> tunnel_watch.log 2>&1; then
     echo "[watch] all artifacts captured — done" >> tunnel_watch.log
     break
   fi
-  sleep 300
+  # Any non-complete run backs off — whether the probe caught the wedge
+  # or it hit mid-step. A live window is consumed INSIDE one queue
+  # invocation (per-step guards keep it running while the tunnel stays
+  # up), so backoff only bounds window-DISCOVERY latency; observed
+  # behavior is long wedges with rare windows, never fast flapping, and
+  # quiet time is what recovery seems to need.
+  backoff=$(( backoff * 2 )); [ "$backoff" -gt 1800 ] && backoff=1800
+  echo "[watch] $(date -u +%H:%M:%S) queue incomplete — sleeping ${backoff}s" >> tunnel_watch.log
+  sleep "$backoff"
 done
