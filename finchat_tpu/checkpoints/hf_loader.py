@@ -97,10 +97,13 @@ def load_llama_params(
         if shardings and path_key in shardings:
             arr = jax.device_put(arr, shardings[path_key])
         if quant:
-            from finchat_tpu.models.quant import quantize, should_quantize
+            from finchat_tpu.models.quant import quantize_stacked, should_quantize
 
             if should_quantize(path_key.rsplit("/", 1)[-1]):
-                qt = quantize(arr)
+                # per-slice for stacked leaves: whole-leaf quantize's fp32
+                # upcast transient (7.5 GB on the 8B mlp stack) would OOM
+                # next to the already-quantized leaves
+                qt = quantize_stacked(arr)
                 # free the bf16 copy before the next tensor materializes
                 jax.block_until_ready(qt.q)
                 del arr

@@ -83,6 +83,23 @@ def dequantize(qt: QTensor, dtype: Any = jnp.bfloat16) -> Array:
     return (qt.q.astype(jnp.float32) * qt.scale[..., None, :]).astype(dtype)
 
 
+def quantize_stacked(w: Array) -> QTensor:
+    """``quantize`` for layer-stacked leaves ``[L, ..., K, N]``, one leading
+    slice at a time. BIT-identical to whole-leaf ``quantize`` (the amax
+    reduce is over the contraction axis only — independent per leading
+    index — and div/round/clip are elementwise; asserted in
+    tests/test_quant.py), but the fp32 upcast transient inside
+    ``quantize`` (``w32 = w.astype(float32)``) is capped at 1/L of the
+    leaf — the difference between fitting and OOM when materializing an
+    8B int8 tree next to already-built leaves on one 16 GB v5e chip.
+    2D (unstacked) weights fall through to plain ``quantize``."""
+    if w.ndim < 3:
+        return quantize(w)
+    parts = [quantize(w[i]) for i in range(w.shape[0])]
+    return QTensor(q=jnp.stack([p.q for p in parts]),
+                   scale=jnp.stack([p.scale for p in parts]))
+
+
 def dense(x: Array, w: Array | QTensor) -> Array:
     """``x @ w`` for a plain or quantized weight (inline dequantization —
     see the module docstring for why not post-matmul scaling)."""
@@ -106,10 +123,18 @@ def init_quantized_llama_params(config: Any, key: Any) -> dict[str, Any]:
     random-weight llama3-8b (16 GB bf16) materialize on one 16 GB v5e chip
     for benching; checkpoint serving gets the same effect from the loader's
     per-tensor path. Identical numerics to ``quantize_llama_params``
-    applied after ``init_params`` (asserted in tests/test_quant.py)."""
+    applied after ``init_params`` (asserted in tests/test_quant.py).
+
+    Stacked leaves go through ``quantize_stacked`` (shared with the HF
+    loader's per-tensor path): whole-leaf eager ``quantize`` would
+    MATERIALIZE its fp32 upcast on top of the already-built tree.
+    (jit-fusing quantize would avoid the transient too but changes the
+    division into reciprocal-multiply and flips round() boundary cases —
+    observed 1 ulp on ~0.006% of weights — breaking the bit-identity
+    this docstring promises.)"""
 
     def leaf_transform(name: str, w: Any) -> Any:
-        return quantize(w) if should_quantize(name) else w
+        return quantize_stacked(w) if should_quantize(name) else w
 
     from finchat_tpu.models.llama import init_params
 
