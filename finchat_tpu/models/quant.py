@@ -92,6 +92,10 @@ def quantize_stacked(w: Array) -> QTensor:
     ``quantize`` (``w32 = w.astype(float32)``) is capped at 1/L of the
     leaf — the difference between fitting and OOM when materializing an
     8B int8 tree next to already-built leaves on one 16 GB v5e chip.
+    (The final ``jnp.stack`` briefly holds the per-slice parts AND the
+    stacked copy — a 2x-int8 transient, ~3.8 GB on the 8B mlp stack,
+    next to the still-live bf16 input: peak ~7.6 GB per leaf vs ~13 GB
+    whole-leaf. Budget headroom against that, not just the fp32 term.)
     2D (unstacked) weights fall through to plain ``quantize``."""
     if w.ndim < 3:
         return quantize(w)
