@@ -42,6 +42,22 @@ import inspect  # noqa: E402
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Free compiled executables between test modules.
+
+    The full suite runs ~600 distinct XLA CPU compilations in one
+    process; at a deterministic point near the end (observed 4/4 at
+    test_warmup, 2026-07-31) the NEXT compilation segfaults inside
+    ``backend_compile_and_load`` — an XLA compiler crash on accumulated
+    jit-cache state, not host OOM (RSS ~6 GB of 125 GB) and not stack
+    (reproduced at ulimit -s 64 MB). Clearing caches per module keeps
+    the executable count bounded; cross-module cache reuse is minimal
+    anyway since shapes/configs differ per module."""
+    yield
+    jax.clear_caches()
+
+
 @pytest.hookimpl(tryfirst=True)
 def pytest_pyfunc_call(pyfuncitem):
     """Run ``async def`` tests with asyncio.run (pytest-asyncio isn't in the
