@@ -72,6 +72,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="also measure the speculative verify step at this "
                         "draft width (engine/spec.py): cost per step and "
                         "the full-acceptance throughput envelope")
+    p.add_argument("--decode-loop-sweep", action="store_true",
+                   help="sweep the fused multi-step decode loop "
+                        "(engine decode_loop_step) over --decode-loop-depths "
+                        "instead of the headline measurement: tok/s, "
+                        "device dispatches per token, and inter-token p99 "
+                        "jitter per depth")
+    p.add_argument("--decode-loop-depths", default="1,4,8",
+                   help="comma-separated depths for --decode-loop-sweep")
     p.add_argument("--tpu-timeout", type=float, default=180.0,
                    help="seconds allowed for TPU backend INIT before the "
                         "child is declared hung (measurement gets "
@@ -123,10 +131,29 @@ def run_worker(args: argparse.Namespace) -> int:
     faulthandler.dump_traceback_later(max(60.0, args.measure_budget - 10.0), exit=True)
 
     work = resolve_workload(args, "tpu" if platform == "tpu" else "cpu")
-    result = measure(attn=args.attn, quant=args.quant or "",
-                     kv_quant=args.kv_quant or "",
-                     spec_tokens=args.spec_tokens or 0, **work)
+    if args.decode_loop_sweep:
+        depths = tuple(int(d) for d in args.decode_loop_depths.split(","))
+        result = measure_decode_loop_sweep(
+            attn=args.attn, quant=args.quant or "",
+            kv_quant=args.kv_quant or "", depths=depths, **work)
+    else:
+        result = measure(attn=args.attn, quant=args.quant or "",
+                         kv_quant=args.kv_quant or "",
+                         spec_tokens=args.spec_tokens or 0, **work)
     result["backend_init_s"] = round(init_s, 1)
+    # provenance stamp: the degraded-mode note (and any later reader)
+    # surfaces these so a stale record is visibly stale
+    result.setdefault(
+        "captured_at", time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    )
+    try:
+        result.setdefault("commit", subprocess.check_output(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)), text=True,
+            stderr=subprocess.DEVNULL,
+        ).strip())
+    except Exception:
+        pass
     print(json.dumps(result), flush=True)
     return 0
 
@@ -398,6 +425,142 @@ def measure(preset: str, batch: int, prompt_len: int, steps: int, warmup: int,
     }
 
 
+def measure_decode_loop_sweep(
+    preset: str, batch: int, prompt_len: int, steps: int, warmup: int,
+    page_size: int, max_seq_len: int, attn: str | None,
+    quant: str = "", kv_quant: str = "", depths: tuple = (1, 4, 8),
+) -> dict:
+    """Sweep the fused multi-step decode loop: for each depth K, time
+    blocks of K decode iterations per device dispatch and report tok/s,
+    the MEASURED device-dispatch count per generated token (counted at the
+    engine call site, not derived), and the host-observed inter-token p99
+    jitter — the K-token burst is a real latency tradeoff: tokens within a
+    block arrive together, so the p99 inter-token gap grows toward one
+    block time as K grows while dispatch overhead amortizes ~K×."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from finchat_tpu.engine.engine import InferenceEngine
+    from finchat_tpu.engine.kv_cache import pages_needed
+    from finchat_tpu.models.llama import PRESETS, init_params
+    from finchat_tpu.ops.dispatch import attention_backend
+    from finchat_tpu.utils.config import EngineConfig
+
+    config = PRESETS[preset]
+    attn = attn or attention_backend()
+    max_K = max(depths)
+    # every depth decodes the same token budget (rounded up to whole
+    # blocks) from the same prefilled state
+    steps = max(steps, 2 * max_K)
+    pages_per_seq = pages_needed(max_seq_len, page_size)
+    engine_cfg = EngineConfig(
+        max_seqs=batch,
+        page_size=page_size,
+        num_pages=batch * pages_per_seq + 8,
+        max_seq_len=max_seq_len,
+        prefill_chunk=max(prompt_len, 128),
+        kv_quant=kv_quant,
+        decode_loop_depth=max_K,
+    )
+    if quant:
+        from finchat_tpu.models.quant import init_quantized_llama_params
+
+        params = init_quantized_llama_params(config, jax.random.key(0))
+    else:
+        params = init_params(config, jax.random.key(0))
+    engine = InferenceEngine(config, params, engine_cfg, attn_backend=attn,
+                             quant=quant)
+
+    rng = np.random.default_rng(0)
+    rows = {
+        slot: list(range(1 + slot * pages_per_seq, 1 + (slot + 1) * pages_per_seq))
+        for slot in range(batch)
+    }
+    items = [
+        (slot, rng.integers(1, config.vocab_size, size=prompt_len).tolist())
+        for slot in range(batch)
+    ]
+
+    active = jnp.ones((batch,), bool)
+    temperature = jnp.zeros((batch,), jnp.float32)  # greedy: EOS-free replay
+    top_p = jnp.ones((batch,), jnp.float32)
+    top_k = jnp.zeros((batch,), jnp.int32)
+
+    def reset_and_prefill() -> None:
+        engine.reset_slots(list(rows))
+        engine.set_page_table_rows(rows)
+        engine.prefill_batch(items)
+        np.asarray(engine.state.context_lens)  # execution barrier
+
+    from finchat_tpu.utils.metrics import METRICS
+
+    def run_blocks(K: int, n_blocks: int) -> tuple[float, list, int]:
+        """Dispatch+fetch n_blocks blocks of K tokens; returns (elapsed,
+        per-token host arrival times, dispatch count). The fetch per block
+        is the point: ONE device→host [K, batch] copy replaces K [batch]
+        copies, and the arrival timeline exposes the burst jitter. The
+        dispatch count is read from the ENGINE's dispatch-seam counter
+        (finchat_decode_dispatches_total, bumped once per enqueued device
+        program) rather than this loop's iteration count — an engine
+        regression that fell back to K host-side steps per 'block' would
+        show up here instead of being assumed away."""
+        before = METRICS.get("finchat_decode_dispatches_total")
+        arrivals: list = []
+        t0 = time.perf_counter()
+        for _ in range(n_blocks):
+            if K == 1:
+                block = np.asarray(engine.decode(active, temperature, top_p, top_k))
+            else:
+                block = np.asarray(
+                    engine.decode_loop(active, temperature, top_p, top_k,
+                                       eos_id=-1, depth=K)
+                )
+            arrivals.extend([time.perf_counter()] * K)
+            assert block.size  # keep the fetch live
+        elapsed = time.perf_counter() - t0
+        dispatches = int(METRICS.get("finchat_decode_dispatches_total") - before)
+        return elapsed, arrivals, dispatches
+
+    sweep = []
+    for K in depths:
+        n_blocks = -(-steps // K)
+        reset_and_prefill()
+        run_blocks(K, max(warmup // K, 1))  # compile + steady-state
+        elapsed, arrivals, dispatches = run_blocks(K, n_blocks)
+        tokens_per_slot = n_blocks * K
+        gaps = np.diff(np.asarray(arrivals))
+        sweep.append({
+            "decode_loop_depth": K,
+            "tok_s": round(batch * tokens_per_slot / elapsed, 1),
+            "block_ms": round(1000 * elapsed / n_blocks, 2),
+            "dispatches": dispatches,
+            "tokens_per_slot": tokens_per_slot,
+            "dispatches_per_token": round(dispatches / tokens_per_slot, 4),
+            "intertoken_p99_ms": round(
+                1000 * float(np.quantile(gaps, 0.99)) if gaps.size else 0.0, 3
+            ),
+        })
+        print(f"[bench] decode_loop K={K}: {sweep[-1]['tok_s']} tok/s, "
+              f"{sweep[-1]['dispatches_per_token']} dispatches/token, "
+              f"p99 jitter {sweep[-1]['intertoken_p99_ms']} ms",
+              file=sys.stderr, flush=True)
+
+    return {
+        "metric": "decode_loop_sweep",
+        "unit": "tok/s/chip",
+        "model": preset,
+        "attn": attn,
+        "quant": quant or "bf16",
+        "kv_quant": kv_quant or "off",
+        "batch": batch,
+        "prompt_len": prompt_len,
+        "sweep": sweep,
+        "platform": jax.devices()[0].platform,
+        "device": str(jax.devices()[0]),
+    }
+
+
 # --------------------------------------------------------------------------
 # Orchestrator: jax-free; spawns workers, never hangs, always prints JSON.
 # --------------------------------------------------------------------------
@@ -412,6 +575,9 @@ def spawn_worker(args: argparse.Namespace, platform: str, timeout: float) -> dic
         v = getattr(args, flag)
         if v is not None:
             cmd += ["--" + flag.replace("_", "-"), str(v)]
+    if args.decode_loop_sweep:
+        cmd += ["--decode-loop-sweep",
+                "--decode-loop-depths", args.decode_loop_depths]
     print(f"[bench] spawning {platform} worker (timeout {timeout:.0f}s)",
           file=sys.stderr, flush=True)
     try:
@@ -472,22 +638,40 @@ def main() -> int:
                 "tinyllama-1.1b bf16 (PERF_r04.md, 2026-07-29; honest "
                 "8B-equivalent vs_baseline ~0.456 per PERF_r05.md)"
             )
-            # prefer the round-5 target-model capture when the tunnel
-            # watcher landed it (benchmarks/onchip_queue.sh)
-            try:
-                with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                       "BENCH_8B_r05.json")) as f:
-                    rec = json.loads(f.read().strip().splitlines()[-1])
+            # prefer the on-chip target-model capture when the tunnel
+            # watcher landed one (benchmarks/onchip_queue.sh). The
+            # artifact name is NOT hardcoded to a round: resolve
+            # FINCHAT_BENCH_8B_ARTIFACT, then the round-agnostic
+            # BENCH_8B_latest.json symlink (the queue maintains it), then
+            # the newest BENCH_8B_r*.json — and surface the record's own
+            # commit/date stamp so staleness is visible (ADVICE r5).
+            here = os.path.dirname(os.path.abspath(__file__))
+            env_art = os.environ.get("FINCHAT_BENCH_8B_ARTIFACT")
+            candidates = [env_art] if env_art else []
+            candidates.append(os.path.join(here, "BENCH_8B_latest.json"))
+            import glob
+
+            candidates.extend(sorted(
+                glob.glob(os.path.join(here, "BENCH_8B_r*.json")),
+                key=os.path.getmtime, reverse=True,
+            ))
+            for path in candidates:
+                try:
+                    with open(path) as f:
+                        rec = json.loads(f.read().strip().splitlines()[-1])
+                except (OSError, ValueError, IndexError):
+                    continue
                 if isinstance(rec, dict) and rec.get("platform") == "tpu":
                     note = (
                         "TPU attempt failed (tunnel down?); CPU fallback "
                         f"number — the measured on-chip record is "
                         f"{rec.get('value')} {rec.get('unit')} on "
-                        f"{rec.get('model')} (BENCH_8B_r05.json, "
-                        f"vs_baseline {rec.get('vs_baseline')})"
+                        f"{rec.get('model')} ({os.path.basename(path)}, "
+                        f"vs_baseline {rec.get('vs_baseline')}, commit "
+                        f"{rec.get('commit', 'unknown')}, captured "
+                        f"{rec.get('captured_at', 'unknown')})"
                     )
-            except (OSError, ValueError, IndexError):
-                pass
+                    break
             result["note"] = note
     print(json.dumps(result))
     return 0

@@ -20,19 +20,41 @@ deadline=$(( $(date +%s) + 86400 ))
 # the tunnel looks wedged we probe LESS often, and reset to the fast
 # cadence the moment a queue run makes progress.
 backoff=300
+
+# Partial-progress detector: the queue's artifacts by name+size+mtime. A
+# queue run that changed ANY of them consumed a live window even if it
+# later re-wedged — reset to the fast cadence, because the tunnel is
+# demonstrably giving windows right now. Matched by PATTERN, not a second
+# copy of the queue's round-numbered list, so a round bump in
+# onchip_queue.sh doesn't silently disarm the detector.
+artifact_state() {
+  # BENCH_8B_r* (not BENCH_8B_*): the round-agnostic BENCH_8B_latest.json
+  # SYMLINK must stay out of the fingerprint — its mtime is queue
+  # bookkeeping, not capture progress
+  stat -c '%n %s %Y' BENCH_8B_r*.json TTFT_r*_tpu*.json \
+    PALLAS_ONCHIP_*.json 2>/dev/null
+}
+
 while [ "$(date +%s)" -lt "$deadline" ]; do
   echo "[watch] $(date -u +%H:%M:%S) running capture queue" >> tunnel_watch.log
+  before=$(artifact_state)
   if bash benchmarks/onchip_queue.sh >> tunnel_watch.log 2>&1; then
     echo "[watch] all artifacts captured — done" >> tunnel_watch.log
     break
   fi
-  # Any non-complete run backs off — whether the probe caught the wedge
-  # or it hit mid-step. A live window is consumed INSIDE one queue
-  # invocation (per-step guards keep it running while the tunnel stays
-  # up), so backoff only bounds window-DISCOVERY latency; observed
-  # behavior is long wedges with rare windows, never fast flapping, and
-  # quiet time is what recovery seems to need.
-  backoff=$(( backoff * 2 )); [ "$backoff" -gt 1800 ] && backoff=1800
-  echo "[watch] $(date -u +%H:%M:%S) queue incomplete — sleeping ${backoff}s" >> tunnel_watch.log
+  # A non-complete run backs off ONLY when it made no progress (probe
+  # caught the wedge, or it died before capturing anything) — a window
+  # is consumed INSIDE one queue invocation, so backoff bounds
+  # window-DISCOVERY latency, and quiet time is what recovery seems to
+  # need. But a run that landed or updated an artifact proves a live
+  # window just happened: reset to the fast cadence so the rest of that
+  # window burst isn't lost to a 30-min sleep.
+  if [ "$(artifact_state)" != "$before" ]; then
+    backoff=300
+    echo "[watch] $(date -u +%H:%M:%S) queue made partial progress — fast cadence (${backoff}s)" >> tunnel_watch.log
+  else
+    backoff=$(( backoff * 2 )); [ "$backoff" -gt 1800 ] && backoff=1800
+    echo "[watch] $(date -u +%H:%M:%S) queue incomplete — sleeping ${backoff}s" >> tunnel_watch.log
+  fi
   sleep "$backoff"
 done

@@ -32,6 +32,10 @@ def main() -> None:
     p.add_argument("--preset", default=None, help="model preset override")
     p.add_argument("--port", type=int, default=None)
     p.add_argument("--no-http", action="store_true", help="Kafka worker loop only")
+    p.add_argument("--decode-loop-depth", type=int, default=None,
+                   help="tokens per fused decode dispatch (engine "
+                        "decode_loop_step); 1 = per-token decode, bench at "
+                        "4/8 — also FINCHAT_DECODE_LOOP_DEPTH")
     args = p.parse_args()
 
     overrides: dict = {}
@@ -39,6 +43,8 @@ def main() -> None:
         overrides["model.preset"] = args.preset
     if args.port:
         overrides["serve.port"] = args.port
+    if args.decode_loop_depth is not None:
+        overrides["engine.decode_loop_depth"] = args.decode_loop_depth
     cfg = load_config(args.config, overrides)
 
     from finchat_tpu.serve.app import build_app

@@ -13,6 +13,10 @@ point has ONE static shape per (batch-bucket) —
   exhausted prompts ride later rounds with ``n_valid = 0``.
 - ``decode_step``: the full ``max_seqs`` slot batch, every step. Inactive
   slots ride along writing their KV to the trash page.
+- ``decode_loop_step``: the same slot batch, ``decode_loop_depth`` fused
+  decode iterations per dispatch (on-device sampling + per-slot EOS mask
+  inside a ``fori_loop``) — the host pays one dispatch and one
+  ``[K, max_seqs]`` token fetch per K tokens instead of per token.
 
 State is donated on every call and the KV cache is updated IN PLACE by the
 Pallas append kernel (ops/kv_append.py) on the decode path — XLA's scatter
@@ -503,6 +507,104 @@ def decode_step(
 
 @partial(
     jax.jit,
+    static_argnames=("config", "page_size", "attn_backend", "loop_depth"),
+    donate_argnums=(1,),
+)
+def decode_loop_step(
+    params: dict[str, Any],
+    state: DecodeState,
+    active: Array,  # [max_seqs] bool
+    temperature: Array,  # [max_seqs]
+    top_p: Array,  # [max_seqs]
+    top_k: Array,  # [max_seqs] int32
+    eos_id: Array,  # scalar int32 (< 0 disables the on-device stop mask)
+    *,
+    config: LlamaConfig,
+    page_size: int,
+    attn_backend: str = "ref",
+    loop_depth: int = 4,
+) -> tuple[DecodeState, Array]:
+    """K fused decode iterations in ONE dispatch (``jax.lax.fori_loop``):
+    the multi-step path that amortizes the per-token synchronization
+    boundary (one ``decode_step`` dispatch + one device→host token fetch +
+    one Python dispatch per generated token) across ``loop_depth`` tokens —
+    the dominant remaining tax once the kernels themselves are tuned
+    (arxiv 2410.23668 "kernel looping").
+
+    Each iteration is EXACTLY the ``decode_step`` body — same forward, same
+    in-place Pallas KV appends, same on-device ``sample`` call with the same
+    per-iteration ``jax.random.split`` rng discipline — so a K-block greedy
+    stream is token-for-token identical to K single steps
+    (tests/test_decode_loop.py pins this).
+
+    On-device stop mask: a slot that samples ``eos_id`` has the EOS token
+    recorded, then free-runs the remaining iterations INACTIVE — KV writes
+    trash-redirected, ``context_lens`` frozen, output rows -1 — instead of
+    forcing an early host exit (a data-dependent loop bound would defeat
+    the single fixed-shape dispatch). Slots inactive at entry stay -1
+    throughout. The host fetches the whole ``[loop_depth, max_seqs]`` block
+    once per dispatch and delivers per-slot rows until EOS/-1.
+
+    Host contract (scheduler ``decode_loop`` mode): slots needing per-token
+    host control — grammar-constrained picks, spec-decode drafts, slots
+    within ``loop_depth`` tokens of their ``max_new_tokens``/page budget —
+    must NOT ride a block; the scheduler demotes them to single-step.
+
+    PRNG: the carried ``state.rng`` splits ONCE per iteration for the whole
+    batch — deliberately the same per-iteration discipline as
+    ``decode_step`` (not a per-slot key tree), so an iteration of the block
+    is bit-identical math to a single step given the same carried state.
+    Non-greedy streams still depend on batch-global rng consumption order
+    (as they always have); greedy streams are rng-independent, which is
+    the block/single-step parity contract the tests pin.
+    """
+    B = active.shape[0]
+
+    def body(i, carry):
+        state, live, token_block = carry
+        tokens = state.last_tokens[:, None]  # [B, 1]
+        positions = state.context_lens[:, None]  # [B, 1]
+        n_valid = live.astype(jnp.int32)  # [B]
+
+        attention = _paged_attention_fn(
+            state.page_table, state.context_lens, n_valid,
+            page_size, config.n_kv_heads, attn_backend,
+        )
+        logits, (k_pages, v_pages, k_scales, v_scales) = forward(
+            params, tokens, positions,
+            config=config, attention=attention,
+            cache=(state.k_pages, state.v_pages, state.k_scales, state.v_scales),
+        )
+        step_logits = logits[:, 0, :]  # [B, vocab]
+
+        rng, sub = jax.random.split(state.rng)
+        next_tokens = sample(step_logits, sub, temperature, top_p, top_k)
+
+        state = dataclasses.replace(
+            state,
+            k_pages=k_pages,
+            v_pages=v_pages,
+            k_scales=k_scales,
+            v_scales=v_scales,
+            context_lens=state.context_lens + n_valid,
+            last_tokens=jnp.where(live, next_tokens, state.last_tokens),
+            rng=rng,
+        )
+        token_block = token_block.at[i].set(jnp.where(live, next_tokens, -1))
+        # EOS is recorded above, THEN the slot goes inactive: later
+        # iterations trash-write and emit -1 (the host's drain sentinel)
+        live = live & (next_tokens != eos_id)
+        return state, live, token_block
+
+    token_block = jnp.full((loop_depth, B), -1, jnp.int32)
+    state, _, token_block = jax.lax.fori_loop(
+        0, loop_depth, body, (state, active, token_block)
+    )
+    return state, token_block
+
+
+@partial(
+    jax.jit,
     static_argnames=("config", "page_size", "attn_backend", "return_logits"),
     donate_argnums=(1,),
 )
@@ -610,6 +712,9 @@ class InferenceEngine:
         self.attn_backend = attn_backend or attention_backend()
         self.engine_cfg = engine_cfg
         self.page_size = engine_cfg.page_size
+        # fused multi-step decode (decode_loop_step): tokens per dispatch;
+        # 1 = per-token decode_step only (today's behavior)
+        self.decode_loop_depth = max(1, engine_cfg.decode_loop_depth)
         self.max_pages_per_seq = min(
             engine_cfg.num_pages - 1,
             -(-engine_cfg.max_seq_len // engine_cfg.page_size),
@@ -907,6 +1012,18 @@ class InferenceEngine:
                 config=self.config, page_size=self.page_size,
                 attn_backend=self.attn_backend, return_logits=return_logits,
             )
+        if self.decode_loop_depth > 1:
+            # the fused multi-step block the scheduler's decode_loop mode
+            # dispatches — all slots inactive, so writes trash-redirect and
+            # context_lens gains zero (eos_id is a runtime scalar, not part
+            # of the jit cache key)
+            self.state, _ = decode_loop_step(
+                self.params, self.state, inactive, temp, top_p, top_k,
+                jnp.int32(-1),
+                config=self.config, page_size=self.page_size,
+                attn_backend=self.attn_backend,
+                loop_depth=self.decode_loop_depth,
+            )
         if cfg.spec_tokens > 0:
             # both verify-step variants (the scheduler's spec decode path)
             zero_drafts = jnp.zeros((B, cfg.spec_tokens), jnp.int32)
@@ -978,12 +1095,39 @@ class InferenceEngine:
         return elapsed
 
     def decode(self, active, temperature, top_p, top_k, return_logits: bool = False):
+        from finchat_tpu.utils.metrics import METRICS
+
+        METRICS.inc("finchat_decode_dispatches_total")
         self.state, next_tokens, logits = decode_step(
             self.params, self.state, active, temperature, top_p, top_k,
             config=self.config, page_size=self.page_size,
             attn_backend=self.attn_backend, return_logits=return_logits,
         )
         return (next_tokens, logits) if return_logits else next_tokens
+
+    def decode_loop(self, active, temperature, top_p, top_k, eos_id: int,
+                    depth: int | None = None):
+        """Fused multi-step decode (see decode_loop_step): K iterations in
+        one dispatch, on-device sampling + EOS mask. Returns the
+        ``[K, max_seqs]`` token block (device array — callers fetch once).
+        ``depth`` overrides the configured ``decode_loop_depth`` (bench
+        sweeps); each distinct depth is its own compiled variant."""
+        from finchat_tpu.utils.metrics import METRICS
+
+        K = depth if depth is not None else self.decode_loop_depth
+        assert K >= 1
+        # counted at the DISPATCH seam (one jitted program enqueued), the
+        # same counter decode() bumps once per step — what bench.py's
+        # dispatches-per-token figure reads, so a host-side fallback that
+        # looped K single steps here would be visible, not assumed away
+        METRICS.inc("finchat_decode_dispatches_total")
+        self.state, token_block = decode_loop_step(
+            self.params, self.state, active, temperature, top_p, top_k,
+            jnp.int32(eos_id),
+            config=self.config, page_size=self.page_size,
+            attn_backend=self.attn_backend, loop_depth=K,
+        )
+        return token_block
 
     def decode_spec(self, active, drafts, n_drafts, temperature, top_p, top_k,
                     return_logits: bool = False):

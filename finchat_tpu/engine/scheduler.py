@@ -23,6 +23,17 @@ model replica:
   written back before its next step, so it sits OUT the speculative step
   (inactive, trash-redirected) and rejoins the following one — advancing
   every other step while unconstrained streams keep full depth-2 cadence.
+- Fused multi-step decode (``decode_loop_depth`` K > 1): slots needing no
+  per-token host control ride ``decode_loop_step`` blocks — K decode
+  iterations, on-device sampling, and the EOS stop mask inside ONE device
+  dispatch, with the host fetching a ``[K, max_seqs]`` token block per
+  round-trip instead of ``[max_seqs]`` per token. Composes with the
+  depth-2 pipeline (block N+1 dispatched before block N is consumed).
+  Grammar-constrained slots, spec-decode iterations, and slots within K
+  tokens of their ``max_new_tokens``/page budget are demoted to
+  single-step (mirroring the SPEC_MISS_DEMOTE machinery) and rejoin
+  blocks when eligibility returns; slots that finish mid-block free-run
+  into the trash page and their tail iterations are counted as waste.
 - Per-sequence failure isolation (SURVEY §5.3): an errored sequence is
   evicted, its pages freed, an error event emitted on its stream, and the
   engine keeps serving the others. The process-level watchdog of the
@@ -114,6 +125,19 @@ class _InFlightStep:
 
 
 @dataclass
+class _InFlightBlock:
+    """A dispatched-but-unconsumed fused decode block (decode_loop mode):
+    one ``[K, max_seqs]`` device token block for the loop-eligible slots,
+    plus the single ``decode_step`` covering the DEMOTED slots (grammar-
+    constrained / within K of budget) dispatched in the same scheduler
+    iteration, if any."""
+
+    block_tokens: object  # [K, max_seqs] int32, device (-1 = no token)
+    block_members: list[tuple[int, SequenceHandle]]
+    step: _InFlightStep | None
+
+
+@dataclass
 class _PrefixJob:
     """An in-progress chunked prefix registration (register_prefix_async):
     the head prefills one chunk per prefill round, riding the same batched
@@ -184,6 +208,13 @@ class ContinuousBatchingScheduler:
         # retrieved rows, so a one-way demotion would miss the recovery).
         self._spec_miss_streak = 0
         self._spec_cooldown = 0
+        # fused multi-step decode (engine decode_loop_step): K > 1 switches
+        # the pipelined path to K-token blocks per dispatch for slots that
+        # need no per-token host control; constrained / near-budget slots
+        # are demoted to a single decode_step riding the same iteration,
+        # and spec-decode iterations keep their own depth-1 verify cadence
+        self.loop_depth = engine.decode_loop_depth
+        METRICS.set_gauge("finchat_decode_loop_depth", self.loop_depth)
         # shared-prefix KV cache: matched at admission so identical prompt
         # heads (the constant system prompt every conversation shares) are
         # prefilled ONCE per process instead of per request — see
@@ -670,6 +701,116 @@ class ContinuousBatchingScheduler:
             constrained_slots=constrained_slots,
         )
 
+    def _undelivered(self, inflight) -> dict[int, int]:
+        """Per-slot token count already dispatched in the still-unconsumed
+        in-flight step/block. ``handle.generated`` lags by exactly this
+        amount at the next dispatch (depth-2 dispatches N+1 BEFORE
+        consuming N), so budget eligibility must subtract it — otherwise a
+        slot with K tokens left would ride TWO consecutive blocks and the
+        second one's K in-place appends would run past its page
+        allocation."""
+        if inflight is None:
+            return {}
+        if isinstance(inflight, _InFlightBlock):
+            ahead = {slot: self.loop_depth for slot, _ in inflight.block_members}
+            if inflight.step is not None:
+                for slot, _ in inflight.step.members:
+                    ahead[slot] = 1
+            return ahead
+        return {slot: 1 for slot, _ in inflight.members}
+
+    def _loop_eligible(self, handle: SequenceHandle, ahead: int = 0) -> bool:
+        """Can this slot ride a fused K-token block? It must need NO
+        per-token host control for the next ``loop_depth`` tokens: no
+        grammar constraint (host-side picks land between steps) and at
+        least K tokens of ``max_new_tokens`` budget left beyond the
+        ``ahead`` tokens still undelivered in the in-flight dispatch (its
+        page allocation covers prompt + max_new, so the budget check also
+        bounds the block's in-place KV appends). Slots that fail are
+        DEMOTED to the single-step decode riding the same iteration and
+        rejoin blocks when eligibility returns — the same
+        demote-and-reprobe shape as SPEC_MISS_DEMOTE."""
+        return (
+            handle.constraint is None
+            and handle.sampling.max_new_tokens - handle.generated - ahead
+            >= self.loop_depth
+        )
+
+    def _dispatch_decode_loop(
+        self, exclude: set[int] = frozenset(),
+        ahead: dict[int, int] | None = None,
+    ) -> _InFlightBlock:
+        """Enqueue one fused K-token decode block (plus a single decode
+        step for any demoted slots) on the device; returns without
+        syncing. The caller guarantees at least one non-excluded
+        loop-eligible slot. ``exclude`` slots (constrained picks still in
+        flight) ride fully inactive, exactly as in _dispatch_decode;
+        ``ahead`` is _undelivered() for the in-flight dispatch."""
+        inject("scheduler.decode")
+        eng = self.engine
+        B = eng.engine_cfg.max_seqs
+        ahead = ahead or {}
+        active = np.zeros((B,), bool)
+        block_members = []
+        demoted: set[int] = set()
+        for slot, handle in self.decoding.items():
+            if slot in exclude:
+                continue
+            if self._loop_eligible(handle, ahead.get(slot, 0)):
+                active[slot] = True
+                block_members.append((slot, handle))
+            else:
+                demoted.add(slot)
+        token_block = eng.decode_loop(
+            jnp.asarray(active),
+            jnp.asarray(self._temperature),
+            jnp.asarray(self._top_p),
+            jnp.asarray(self._top_k),
+            eos_id=self.eos_id,
+        )
+        METRICS.inc("finchat_decode_loop_blocks_total")
+        METRICS.set_gauge("finchat_decode_loop_demoted_slots", len(demoted))
+        step = None
+        if demoted:
+            # demoted slots advance one token via the plain step — exclude
+            # everything that rode the block (and the pending constrained
+            # slots, which sit this iteration out entirely)
+            step = self._dispatch_decode(exclude=set(self.decoding) - demoted)
+        return _InFlightBlock(
+            block_tokens=token_block, block_members=block_members, step=step
+        )
+
+    async def _consume_block(self, blk: _InFlightBlock) -> None:
+        """Fetch a dispatched block's ``[K, max_seqs]`` tokens (one
+        device→host round-trip for K steps' worth of output) and drain each
+        member slot's row: deliver until EOS/length finishes the sequence
+        or a -1 sentinel marks where the device's stop mask kicked in.
+        Device iterations spent free-running past a finished slot are the
+        price of the fixed-shape block — counted as wasted tail tokens."""
+        tokens_host = await asyncio.to_thread(
+            lambda: np.asarray(blk.block_tokens)
+        )
+        K = tokens_host.shape[0]
+        wasted = 0
+        for slot, handle in blk.block_members:
+            if handle.finished or handle.slot != slot:
+                wasted += K  # evicted/cancelled since dispatch
+                continue
+            for i in range(K):
+                token = int(tokens_host[i, slot])
+                if token < 0:  # device stop mask: EOS'd at i-1, free-ran
+                    wasted += K - i
+                    break
+                self._deliver(handle, token)
+                if handle.finished:  # EOS (host view) / length / cancel
+                    wasted += K - i - 1
+                    break
+        if wasted:
+            METRICS.inc("finchat_decode_loop_wasted_tail_tokens_total", wasted)
+        if blk.step is not None:
+            await self._consume_step(blk.step)
+        METRICS.set_gauge("finchat_batch_occupancy", len(self.decoding))
+
     @staticmethod
     def _spec_eligible(handle: SequenceHandle) -> bool:
         """Can this slot benefit from drafts? Greedy, unconstrained, and at
@@ -820,14 +961,28 @@ class ContinuousBatchingScheduler:
                 self._deliver(handle, int(tokens_host[slot]))
         METRICS.set_gauge("finchat_batch_occupancy", len(self.decoding))
 
+    def _pending_constrained(self, inflight) -> set[int]:
+        """Constrained slots whose host-side pick lands only when
+        ``inflight`` is consumed — they must sit out the next dispatch.
+        In a block, constrained slots only ever ride the demoted step."""
+        if isinstance(inflight, _InFlightBlock):
+            return set(inflight.step.constrained_slots) if inflight.step else set()
+        return set(inflight.constrained_slots)
+
+    async def _consume_inflight(self, inflight) -> None:
+        if isinstance(inflight, _InFlightBlock):
+            await self._consume_block(inflight)
+        else:
+            await self._consume_step(inflight)
+
     async def _loop(self) -> None:
         logger.info("scheduler loop started (max_seqs=%d)", self.engine.engine_cfg.max_seqs)
-        inflight: _InFlightStep | None = None
+        inflight: _InFlightStep | _InFlightBlock | None = None
         while self._running:
             if not (self.pending or self.prefilling or self.decoding
                     or self._prefix_jobs):
                 if inflight is not None:  # drain the pipeline before idling
-                    await self._consume_step(inflight)
+                    await self._consume_inflight(inflight)
                     inflight = None
                     continue
                 self._wakeup.clear()
@@ -868,7 +1023,7 @@ class ContinuousBatchingScheduler:
                     # out. Drain any pipelined step left over from the
                     # depth-2 path before switching modes.
                     if inflight is not None:
-                        await self._consume_step(inflight)
+                        await self._consume_inflight(inflight)
                         inflight = None
                     await self._run_spec_step()
                 except Exception as e:
@@ -884,20 +1039,37 @@ class ContinuousBatchingScheduler:
                     # before that consume (it rejoins the following one,
                     # advancing every other step). Unconstrained slots keep
                     # the full depth-2 cadence throughout (verdict r3 #6).
-                    pending = set(inflight.constrained_slots) if inflight is not None else set()
-                    if any(slot not in pending for slot in self.decoding):
+                    pending = self._pending_constrained(inflight) if inflight is not None else set()
+                    ahead = self._undelivered(inflight)
+                    use_loop = self.loop_depth > 1 and any(
+                        slot not in pending
+                        and self._loop_eligible(h, ahead.get(slot, 0))
+                        for slot, h in self.decoding.items()
+                    )
+                    if use_loop:
+                        # decode_loop mode, same depth-2 shape: dispatch
+                        # block N+1 (loop-eligible slots fused K steps,
+                        # demoted slots one plain step, pending constrained
+                        # slots out entirely), then consume block N — the
+                        # device runs K decode iterations while the host
+                        # delivers the previous K tokens per slot
+                        blk = self._dispatch_decode_loop(exclude=pending, ahead=ahead)
+                        if inflight is not None:
+                            await self._consume_inflight(inflight)
+                        inflight = blk
+                    elif any(slot not in pending for slot in self.decoding):
                         # depth-2 pipeline: dispatch N+1 (sans pending
                         # constrained slots), then consume N — the device
                         # computes while the host delivers tokens
                         step = self._dispatch_decode(exclude=pending)
                         if inflight is not None:
-                            await self._consume_step(inflight)
+                            await self._consume_inflight(inflight)
                         inflight = step
                     else:
                         # every decoding slot is waiting on a host pick:
                         # drain, then run depth-1
                         if inflight is not None:
-                            await self._consume_step(inflight)
+                            await self._consume_inflight(inflight)
                             inflight = None
                         if self.decoding:
                             await self._consume_step(self._dispatch_decode())
@@ -909,7 +1081,7 @@ class ContinuousBatchingScheduler:
                     for handle in list(self.decoding.values()):
                         self._evict(handle, "error", error=str(e))
             elif inflight is not None:
-                await self._consume_step(inflight)
+                await self._consume_inflight(inflight)
                 inflight = None
 
             await asyncio.sleep(0)  # let producers/consumers run

@@ -83,6 +83,15 @@ def dequantize(qt: QTensor, dtype: Any = jnp.bfloat16) -> Array:
     return (qt.q.astype(jnp.float32) * qt.scale[..., None, :]).astype(dtype)
 
 
+def _set_stacked_slice(buf: Array, i: Array, part: Array) -> Array:
+    """In-place-able write of slice ``i`` into the stacked output buffer
+    (donated, so XLA updates the buffer rather than copying the stack)."""
+    return jax.lax.dynamic_update_index_in_dim(buf, part, i, 0)
+
+
+_set_stacked_slice = jax.jit(_set_stacked_slice, donate_argnums=(0,))
+
+
 def quantize_stacked(w: Array) -> QTensor:
     """``quantize`` for layer-stacked leaves ``[L, ..., K, N]``, one leading
     slice at a time. BIT-identical to whole-leaf ``quantize`` (the amax
@@ -92,16 +101,38 @@ def quantize_stacked(w: Array) -> QTensor:
     ``quantize`` (``w32 = w.astype(float32)``) is capped at 1/L of the
     leaf — the difference between fitting and OOM when materializing an
     8B int8 tree next to already-built leaves on one 16 GB v5e chip.
-    (The final ``jnp.stack`` briefly holds the per-slice parts AND the
-    stacked copy — a 2x-int8 transient, ~3.8 GB on the 8B mlp stack,
-    next to the still-live bf16 input: peak ~7.6 GB per leaf vs ~13 GB
-    whole-leaf. Budget headroom against that, not just the fp32 term.)
+
+    Two OOM guards beyond the slicing itself (ADVICE r5):
+
+    - The loop SYNCHRONIZES on each slice (``jax.block_until_ready``)
+      before dispatching the next. Async dispatch would otherwise enqueue
+      all L slice programs at once and several ~235 MB fp32 transients
+      could be live simultaneously during 8B init — exactly the cap this
+      function promises.
+    - The stacked q/scale build incrementally via DONATED in-place slice
+      writes instead of ``jnp.stack``: the stack briefly held every
+      per-slice part AND the stacked copy — a 2x-int8 transient, ~3.8 GB
+      on the 8B mlp stack next to the still-live bf16 input — while the
+      donated write keeps ONE output buffer plus a single in-flight slice.
+
     2D (unstacked) weights fall through to plain ``quantize``."""
     if w.ndim < 3:
         return quantize(w)
-    parts = [quantize(w[i]) for i in range(w.shape[0])]
-    return QTensor(q=jnp.stack([p.q for p in parts]),
-                   scale=jnp.stack([p.scale for p in parts]))
+    L = w.shape[0]
+    q = scale = None
+    for i in range(L):
+        # eager on purpose: jit-fusing quantize flips round() boundary
+        # cases (see init_quantized_llama_params) and would break the
+        # bit-identity promised above
+        part = quantize(w[i])
+        jax.block_until_ready(part.q)  # one slice's transients at a time
+        if q is None:
+            q = jnp.zeros((L,) + part.q.shape, part.q.dtype)
+            scale = jnp.zeros((L,) + part.scale.shape, part.scale.dtype)
+        idx = jnp.int32(i)
+        q = _set_stacked_slice(q, idx, part.q[None])
+        scale = _set_stacked_slice(scale, idx, part.scale[None])
+    return QTensor(q=q, scale=scale)
 
 
 def dense(x: Array, w: Array | QTensor) -> Array:

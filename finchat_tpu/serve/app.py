@@ -64,6 +64,94 @@ logger = get_logger(__name__)
 _PROMPTS_DIR = Path(__file__).resolve().parent.parent.parent / "prompts"
 
 
+_REPACE_DONE = object()
+
+
+async def _repace_bursts(updates, loop_depth: int):
+    """Smooth the fused decode loop's K-token bursts for SSE clients.
+
+    With ``decode_loop_depth`` K > 1 the scheduler delivers K token events
+    per device dispatch, so the raw stream is K chunks back-to-back then a
+    block-length gap — a visible stutter at the terminal. This pacer keeps
+    the per-chunk emit (every token is still its own SSE frame, flushed
+    individually by HTTPServer) but spreads each burst over the observed
+    block cadence.
+
+    A reader task timestamps arrivals BEFORE any pacing sleep — measuring
+    gaps on the paced consumer side would fold our own sleeps into the
+    estimate (the boundary gap shrinks by (K-1)·pace and the EMA converges
+    to ~half the true block time, leaving a residual stall). Burst starts
+    are detected on the true timeline (members of one block land within
+    ~µs of each other), the EMA runs over burst-START-to-burst-start
+    deltas (= the true block period), and members are emitted ~block/K
+    apart. Added latency is bounded: a chunk is never held past one
+    EMA-block after its arrival (drain guard) nor paced more than
+    50 ms/token. K <= 1 is a passthrough."""
+    if loop_depth <= 1:
+        async for update in updates:
+            yield update
+        return
+    import time as _time
+
+    queue: asyncio.Queue = asyncio.Queue()
+
+    async def _reader():
+        try:
+            async for update in updates:
+                queue.put_nowait((_time.monotonic(), update))
+        except BaseException as e:  # propagate into the consumer
+            queue.put_nowait((0.0, e))
+            return
+        queue.put_nowait((0.0, _REPACE_DONE))
+
+    reader = asyncio.create_task(_reader())
+    ema: float | None = None
+    burst_start: float | None = None
+    last_arrival: float | None = None
+    next_emit = 0.0
+    try:
+        while True:
+            t_arr, update = await queue.get()
+            if update is _REPACE_DONE:
+                return
+            if isinstance(update, BaseException):
+                raise update
+            if update.get("type") != "response_chunk":
+                yield update
+                continue
+            # burst-boundary threshold: EMA-relative with a 10 ms floor —
+            # a µs-scale cutoff would let ordinary event-loop jitter
+            # between same-block dequeues fragment one burst into several,
+            # polluting the EMA with near-zero deltas until the pacer
+            # silently degrades to passthrough under load. The floor is
+            # safe: a stream whose REAL block boundaries are under 10 ms
+            # is already >100 tokens/s/slot and needs no smoothing
+            threshold = max(1e-2, ema / (2 * loop_depth)) if ema else 1e-2
+            if last_arrival is None or t_arr - last_arrival > threshold:
+                if burst_start is not None:
+                    delta = t_arr - burst_start
+                    ema = delta if ema is None else 0.7 * ema + 0.3 * delta
+                burst_start = t_arr
+            last_arrival = t_arr
+            if ema:
+                pace = min(ema / loop_depth, 0.05)
+                now = _time.monotonic()
+                # pace from the previous emit, but never hold a chunk past
+                # one block after its true arrival (bounds added latency
+                # and lets a backed-up queue drain)
+                target = min(max(now, next_emit), t_arr + ema)
+                if target > now:
+                    await asyncio.sleep(target - now)
+                next_emit = target + pace
+            yield update
+    finally:
+        reader.cancel()
+        try:
+            await reader
+        except (asyncio.CancelledError, Exception):
+            pass
+
+
 def load_prompts() -> tuple[str, str]:
     system_prompt = (_PROMPTS_DIR / "system_prompt.txt").read_text()
     tool_prompt = (_PROMPTS_DIR / "tool_prompt.txt").read_text()
@@ -330,9 +418,12 @@ class App:
         chat_history = await self.store.get_history(payload["conversation_id"])
 
         async def events():
-            async for update in self.agent.stream_with_status(
+            updates = self.agent.stream_with_status(
                 payload["message"], payload["user_id"], user_context, chat_history
-            ):
+            )
+            # decode_loop bursts re-pace through the SAME per-chunk emit —
+            # clients see a smooth token cadence, not K-frame stutters
+            async for update in _repace_bursts(updates, self.cfg.engine.decode_loop_depth):
                 yield sse_event(update)
 
         return StreamingResponse(chunks=events())

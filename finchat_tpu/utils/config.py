@@ -195,6 +195,15 @@ class EngineConfig:
     # collectives when heads divide the seq axis — falls back to ring
     # when they don't)
     sp_mode: str = "ring"
+    # fused multi-step decode (engine decode_loop_step): tokens generated
+    # per device dispatch. 1 = today's per-token decode_step. K > 1 runs K
+    # decode iterations inside one jitted fori_loop — on-device sampling,
+    # in-place KV appends, per-slot EOS mask — cutting host↔device
+    # round-trips and Python dispatch overhead ~K× at the cost of up to K
+    # steps of inter-token burstiness (the SSE path re-paces emits).
+    # Grammar-constrained, spec-decode, and within-K-of-budget slots are
+    # demoted to single-step by the scheduler. Bench at 4/8.
+    decode_loop_depth: int = 1
     # chunked ring prefill: segment size (tokens) for the seq-sharded
     # prefill. > 0 splits a ring-eligible prompt into segments that
     # interleave with decode steps in the scheduler loop (each segment
@@ -296,6 +305,9 @@ def load_config(
         "FINCHAT_RING_PREFILL_MIN", cfg.engine.ring_prefill_min_tokens
     )
     cfg.engine.spec_tokens = _env_int("FINCHAT_SPEC_TOKENS", cfg.engine.spec_tokens)
+    cfg.engine.decode_loop_depth = _env_int(
+        "FINCHAT_DECODE_LOOP_DEPTH", cfg.engine.decode_loop_depth
+    )
     cfg.engine.ring_prefill_chunk = _env_int(
         "FINCHAT_RING_PREFILL_CHUNK", cfg.engine.ring_prefill_chunk
     )

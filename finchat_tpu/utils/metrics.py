@@ -3,6 +3,14 @@
 The reference has no metrics (SURVEY §5.5); these counters ARE the product's
 north-star surface (tok/s/chip, TTFT, queue depth, batch occupancy, KV-page
 utilization), exported in Prometheus text format at ``/metrics``.
+
+Decode-loop family (scheduler decode_loop mode, engine decode_loop_step):
+``finchat_decode_loop_depth`` (gauge — configured K),
+``finchat_decode_loop_blocks_total`` (fused K-token blocks dispatched),
+``finchat_decode_loop_wasted_tail_tokens_total`` (device iterations spent
+free-running past finished slots — the fixed-shape block's overhead), and
+``finchat_decode_loop_demoted_slots`` (gauge — slots currently advancing
+via single-step because they need per-token host control).
 """
 
 from __future__ import annotations

@@ -26,12 +26,15 @@ import jax  # noqa: E402
 if not _ON_TPU:
     jax.config.update("jax_platforms", "cpu")
     assert jax.device_count() == 8, "tests require the virtual 8-device CPU mesh"
-elif os.environ.get("FINCHAT_REQUIRE_TPU"):
+if os.environ.get("FINCHAT_REQUIRE_TPU"):
     # On-chip capture harnesses (benchmarks/pallas_onchip_split.py) set this
     # so a silent CPU fallback (tunnel init failing FAST instead of hanging)
     # can never produce a passing "on-chip" parity record: the kernel tests
     # would run interpret=True on CPU and pass, and the artifact would claim
-    # interpret=False hardware coverage it never had.
+    # interpret=False hardware coverage it never had. Checked UNCONDITIONALLY
+    # (not only under FINCHAT_TESTS_TPU): a harness that sets REQUIRE_TPU
+    # but loses the TESTS_TPU flag would otherwise run the suite on the
+    # forced-CPU mesh with the guard silently disarmed (ADVICE r5).
     assert jax.default_backend() == "tpu", (
         f"FINCHAT_REQUIRE_TPU=1 but backend is {jax.default_backend()!r}"
     )

@@ -1,0 +1,364 @@
+"""Fused multi-step decode loop (engine decode_loop_step + scheduler
+decode_loop mode).
+
+The contract under test: a K-token block is pure dispatch-amortization —
+greedy output is TOKEN-FOR-TOKEN identical to K single steps (including
+EOS-mid-block and budget-edge sequences), slots needing per-token host
+control are demoted to single-step and rejoin, and warmup covers the new
+jit variant so the first block compiles nothing."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from finchat_tpu.engine.engine import InferenceEngine, commit_first_token
+from finchat_tpu.engine.kv_cache import PageAllocator, pages_needed
+from finchat_tpu.engine.sampler import SamplingParams
+from finchat_tpu.engine.scheduler import ContinuousBatchingScheduler
+from finchat_tpu.models.llama import PRESETS, init_params
+from finchat_tpu.models.tokenizer import ByteTokenizer
+from finchat_tpu.utils.config import EngineConfig
+
+CONFIG = PRESETS["tiny"]
+K = 4
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CONFIG, jax.random.key(0))
+
+
+def _engine(params, depth=K, max_seqs=4):
+    cfg = EngineConfig(
+        max_seqs=max_seqs, page_size=8, num_pages=64, max_seq_len=128,
+        prefill_chunk=8, decode_loop_depth=depth,
+    )
+    return InferenceEngine(CONFIG, params, cfg)
+
+
+def _arm_slot(eng, alloc, slot, prompt, n_new, seq_id="s"):
+    pages = alloc.allocate(seq_id, pages_needed(len(prompt) + n_new, eng.page_size))
+    eng.set_page_table_row(slot, pages)
+    logits = eng.prefill(slot, prompt)
+    eng.state, tok = commit_first_token(
+        eng.state, jnp.int32(slot), logits,
+        jnp.float32(0.0), jnp.float32(1.0), jnp.int32(0),
+    )
+    return int(tok)
+
+
+def _greedy_args(B):
+    return jnp.zeros((B,)), jnp.ones((B,)), jnp.zeros((B,), jnp.int32)
+
+
+# --- engine level -----------------------------------------------------------
+
+def test_block_matches_single_steps_greedy(params):
+    """One K-block produces exactly the K tokens that K decode_steps would,
+    for two slots with different context lengths in the same batch."""
+    prompts = {0: [3, 7, 11, 200, 42], 2: [100, 101, 102]}
+    n_new = 2 * K + 1
+
+    ref = _engine(params, depth=1)
+    ref_alloc = PageAllocator(ref.engine_cfg.num_pages)
+    streams = {s: [_arm_slot(ref, ref_alloc, s, p, n_new, seq_id=f"r{s}")]
+               for s, p in prompts.items()}
+    B = ref.engine_cfg.max_seqs
+    active = jnp.zeros((B,), bool).at[0].set(True).at[2].set(True)
+    z, o, zk = _greedy_args(B)
+    for _ in range(n_new - 1):
+        nxt = ref.decode(active, z, o, zk)
+        for s in prompts:
+            streams[s].append(int(nxt[s]))
+
+    eng = _engine(params, depth=K)
+    alloc = PageAllocator(eng.engine_cfg.num_pages)
+    got = {s: [_arm_slot(eng, alloc, s, p, n_new, seq_id=f"g{s}")]
+           for s, p in prompts.items()}
+    while any(len(v) < n_new for v in got.values()):
+        block = np.asarray(eng.decode_loop(active, z, o, zk, eos_id=-1))
+        assert block.shape == (K, B)
+        for row in block:
+            for s in prompts:
+                if len(got[s]) < n_new:
+                    got[s].append(int(row[s]))
+    assert got == streams
+
+
+def test_block_eos_mid_block_stops_slot(params):
+    """A slot sampling eos_id mid-block records the EOS token, then
+    free-runs: -1 rows after it, context_lens frozen, while the OTHER slot
+    keeps generating through the whole block."""
+    prompt = [3, 7, 11, 200, 42]
+    other = [9, 8, 7, 6]
+    n_new = K + 1
+
+    ref = _engine(params, depth=1)
+    stream = [_arm_slot(ref, PageAllocator(ref.engine_cfg.num_pages), 0, prompt, n_new)]
+    B = ref.engine_cfg.max_seqs
+    active0 = jnp.zeros((B,), bool).at[0].set(True)
+    z, o, zk = _greedy_args(B)
+    for _ in range(n_new - 1):
+        stream.append(int(ref.decode(active0, z, o, zk)[0]))
+    eos = stream[2]  # greedy emits this 2 tokens into the block
+
+    eng = _engine(params, depth=K)
+    alloc = PageAllocator(eng.engine_cfg.num_pages)
+    first0 = _arm_slot(eng, alloc, 0, prompt, n_new, seq_id="a")
+    _arm_slot(eng, alloc, 1, other, n_new, seq_id="b")
+    assert first0 == stream[0]
+    active = jnp.zeros((B,), bool).at[0].set(True).at[1].set(True)
+    ctx_before = np.asarray(eng.state.context_lens).copy()
+    block = np.asarray(eng.decode_loop(active, z, o, zk, eos_id=eos))
+    # slot 0: tokens up to and INCLUDING the EOS, then the -1 sentinel
+    assert block[0, 0] == stream[1]
+    assert block[1, 0] == stream[2] == eos
+    assert block[2, 0] == -1 and block[3, 0] == -1
+    # slot 1 generated a real token every iteration
+    assert (block[:, 1] >= 0).all()
+    ctx = np.asarray(eng.state.context_lens)
+    assert ctx[0] == ctx_before[0] + 2  # frozen after EOS
+    assert ctx[1] == ctx_before[1] + K
+
+
+def test_inactive_slots_emit_sentinels_and_stay_frozen(params):
+    """Slots inactive at entry produce -1 for every row and gain no
+    context — the trash-page free-run contract."""
+    eng = _engine(params, depth=K)
+    _arm_slot(eng, PageAllocator(eng.engine_cfg.num_pages), 0, [5, 9, 2], K + 1)
+    B = eng.engine_cfg.max_seqs
+    active = jnp.zeros((B,), bool).at[0].set(True)
+    z, o, zk = _greedy_args(B)
+    block = np.asarray(eng.decode_loop(active, z, o, zk, eos_id=-1))
+    assert (block[:, 1:] == -1).all()
+    assert np.asarray(eng.state.context_lens)[1:].tolist() == [0] * (B - 1)
+
+
+# --- scheduler level --------------------------------------------------------
+
+async def _collect_streams(scheduler, tok, budgets, temperature=0.0):
+    handles = []
+    for i, n in enumerate(budgets):
+        handles.append(await scheduler.submit(
+            f"s{i}", tok.encode(f"prompt {i}", add_bos=True),
+            SamplingParams(temperature=temperature, max_new_tokens=n),
+        ))
+    streams = []
+    for h in handles:
+        toks = []
+        while True:
+            event = await asyncio.wait_for(h.events.get(), timeout=120)
+            if event["type"] == "token":
+                toks.append(event["token_id"])
+            elif event["type"] == "done":
+                assert h.events.empty()
+                break
+            else:
+                raise AssertionError(event)
+        streams.append(toks)
+    return streams
+
+
+def _stack(params, depth, eos_id=None, spec_tokens=0, max_seqs=4):
+    tok = ByteTokenizer()
+    cfg = EngineConfig(
+        max_seqs=max_seqs, page_size=8, num_pages=128, max_seq_len=128,
+        prefill_chunk=16, decode_loop_depth=depth, spec_tokens=spec_tokens,
+    )
+    engine = InferenceEngine(CONFIG, params, cfg)
+    scheduler = ContinuousBatchingScheduler(
+        engine, eos_id=tok.eos_id if eos_id is None else eos_id
+    )
+    return tok, scheduler
+
+
+def test_scheduler_streams_identical_to_single_step(params):
+    """Greedy token streams under decode_loop_depth=K are identical to
+    depth 1 — budgets chosen to hit the budget-edge demotion (3 < K never
+    rides a block; 7 and 13 end with a sub-K tail of single steps)."""
+
+    async def run(depth):
+        tok, scheduler = _stack(params, depth, eos_id=-1)
+        await scheduler.start()
+        try:
+            return await _collect_streams(scheduler, tok, [3, 7, 13])
+        finally:
+            await scheduler.stop()
+
+    base = asyncio.run(run(1))
+    loop = asyncio.run(run(K))
+    assert [len(s) for s in base] == [3, 7, 13]
+    assert loop == base
+
+
+def test_scheduler_eos_mid_block_matches_single_step(params):
+    """A sequence whose greedy continuation hits EOS mid-block terminates at
+    the same token under K-blocks as under single steps, and the slot's
+    capacity is reclaimed (free-run tokens never leak into the stream)."""
+
+    async def run(depth, eos_id):
+        tok, scheduler = _stack(params, depth, eos_id=eos_id)
+        await scheduler.start()
+        try:
+            streams = await _collect_streams(scheduler, tok, [32])
+            assert sorted(scheduler.free_slots) == list(range(4))
+            scheduler.allocator.check_invariants()
+            return streams
+        finally:
+            await scheduler.stop()
+
+    # find what greedy emits, then make token at index K+1 (mid-block 2)
+    # the EOS id for both runs
+    probe = asyncio.run(run(1, -1))[0]
+    eos = probe[K + 1]
+    base = asyncio.run(run(1, eos))
+    loop = asyncio.run(run(K, eos))
+    assert loop == base
+    # EOS is consumed, not delivered: the stream is the probe prefix
+    assert base[0] == probe[: probe.index(eos)]
+
+
+def test_pipelined_blocks_respect_budget_edge(params):
+    """Depth-2 dispatches block N+1 BEFORE consuming block N, so
+    eligibility must subtract the K undelivered in-flight tokens: a
+    sequence with budget < 2K rides exactly ONE block — a second would
+    append up to K KV entries past its page allocation."""
+
+    async def run():
+        tok, scheduler = _stack(params, K, eos_id=-1)
+        blocks: list[np.ndarray] = []
+        real_loop = scheduler.engine.decode_loop
+
+        def spy(active, *a, **kw):
+            blocks.append(np.asarray(active).copy())
+            return real_loop(active, *a, **kw)
+
+        scheduler.engine.decode_loop = spy
+        await scheduler.start()
+        try:
+            streams = await _collect_streams(scheduler, tok, [K + 2])
+            return streams, blocks
+        finally:
+            await scheduler.stop()
+
+    streams, blocks = asyncio.run(run())
+    assert len(streams[0]) == K + 2  # exact budget, no leaked block tokens
+    slot_blocks = sum(1 for m in blocks if m.any())
+    assert slot_blocks == 1, f"budget-{K + 2} sequence rode {slot_blocks} blocks"
+
+
+def test_constrained_slot_demoted_to_single_step(params):
+    """A grammar-constrained slot must never ride a fused block (its pick
+    lands between steps); it advances via the demoted single step while the
+    bystander rides blocks, and both streams complete."""
+    from finchat_tpu.agent.constrained import GrammarVocab, TokenConstraint
+
+    async def run():
+        tok, scheduler = _stack(params, K, max_seqs=2)
+        vocab = GrammarVocab.for_tokenizer(tok)
+        block_actives: list[np.ndarray] = []
+        real_loop = scheduler.engine.decode_loop
+
+        def spy_loop(active, *args, **kwargs):
+            block_actives.append(np.asarray(active).copy())
+            return real_loop(active, *args, **kwargs)
+
+        scheduler.engine.decode_loop = spy_loop
+        await scheduler.start()
+        try:
+            bystander = await scheduler.submit(
+                "bystander", tok.encode("hello", add_bos=True),
+                SamplingParams(temperature=0.0, max_new_tokens=24),
+            )
+            constrained = await scheduler.submit(
+                "tool", tok.encode("decide", add_bos=True),
+                SamplingParams(temperature=0.0, max_new_tokens=24),
+                constraint=TokenConstraint(vocab),
+            )
+            by_count = tool_count = 0
+            done = {id(bystander): False, id(constrained): False}
+            while not all(done.values()):
+                progressed = False
+                for h in (bystander, constrained):
+                    if done[id(h)]:
+                        continue
+                    try:
+                        event = h.events.get_nowait()
+                    except asyncio.QueueEmpty:
+                        continue
+                    progressed = True
+                    if event["type"] == "token":
+                        if h is bystander:
+                            by_count += 1
+                        else:
+                            tool_count += 1
+                    elif event["type"] in ("done", "error"):
+                        done[id(h)] = True
+                if not progressed:
+                    await asyncio.sleep(0.005)
+            by_slot, tool_slot = bystander.slot, constrained.slot
+            return by_count, tool_count, block_actives
+        finally:
+            await scheduler.stop()
+
+    by_count, tool_count, block_actives = asyncio.run(run())
+    assert by_count == 24  # bystander got its full budget via blocks
+    assert tool_count >= 1  # the grammar emitted something
+    assert block_actives, "no fused blocks dispatched"
+    # exactly one slot (the bystander) ever rides a block
+    for active in block_actives:
+        assert active.sum() == 1, active
+
+
+def test_spec_mode_demotes_then_rejoins_blocks(params):
+    """With speculative decoding configured, greedy slots run the per-token
+    verify cadence first; once the all-miss streak demotes spec
+    (SPEC_MISS_DEMOTE), the batch rejoins fused blocks for the cooldown
+    window — blocks must appear only after the demotion."""
+
+    async def run():
+        tok, scheduler = _stack(params, K, eos_id=-1, spec_tokens=2)
+        first_block_cooldown = []
+        real_loop = scheduler.engine.decode_loop
+
+        def spy_loop(active, *args, **kwargs):
+            first_block_cooldown.append(scheduler._spec_cooldown)
+            return real_loop(active, *args, **kwargs)
+
+        scheduler.engine.decode_loop = spy_loop
+        await scheduler.start()
+        try:
+            streams = await _collect_streams(scheduler, tok, [40])
+            return streams, first_block_cooldown
+        finally:
+            await scheduler.stop()
+
+    streams, cooldowns = asyncio.run(run())
+    assert len(streams[0]) == 40
+    assert cooldowns, "blocks never engaged after spec demotion"
+    # every block ran inside a spec-demotion cooldown window
+    assert all(c > 0 for c in cooldowns), cooldowns
+
+
+def test_wasted_tail_metric_counts_free_run(params):
+    """EOS mid-block leaves K - delivered device iterations as waste; the
+    gauge/counter surface must record them."""
+    from finchat_tpu.utils.metrics import METRICS
+
+    async def run(eos_id):
+        tok, scheduler = _stack(params, K, eos_id=eos_id)
+        await scheduler.start()
+        try:
+            return await _collect_streams(scheduler, tok, [32])
+        finally:
+            await scheduler.stop()
+
+    probe = asyncio.run(run(-1))[0]
+    eos = probe[K + 1]  # mid-block EOS → a free-run tail
+    before = METRICS.get("finchat_decode_loop_wasted_tail_tokens_total")
+    asyncio.run(run(eos))
+    after = METRICS.get("finchat_decode_loop_wasted_tail_tokens_total")
+    assert after > before

@@ -13,6 +13,7 @@ import numpy as np
 from finchat_tpu.engine.engine import (
     InferenceEngine,
     commit_first_token,
+    decode_loop_step,
     decode_step,
     prefill_step,
     verify_step,
@@ -22,11 +23,11 @@ from finchat_tpu.models.llama import PRESETS, init_params
 from finchat_tpu.utils.config import EngineConfig
 
 
-def _tiny_engine(max_seqs=2, spec_tokens=0):
+def _tiny_engine(max_seqs=2, spec_tokens=0, decode_loop_depth=1):
     config = PRESETS["tiny"]
     engine_cfg = EngineConfig(
         max_seqs=max_seqs, page_size=8, num_pages=32, max_seq_len=64, prefill_chunk=8,
-        spec_tokens=spec_tokens,
+        spec_tokens=spec_tokens, decode_loop_depth=decode_loop_depth,
     )
     params = init_params(config, jax.random.key(0))
     return InferenceEngine(config, params, engine_cfg, attn_backend="ref")
@@ -91,6 +92,35 @@ def test_warmup_covers_spec_verify_variants():
     eng.decode_spec(active, drafts, n_drafts, zeros, ones, zk, return_logits=True)
 
     assert verify_step._cache_size() == before, "first verify step recompiled"
+
+
+def test_warmup_covers_decode_loop_variant():
+    """With decode_loop_depth > 1 the scheduler's fused K-token block
+    (decode_loop_step) must be compiled at startup — and the eos_id being a
+    runtime scalar (not a jit cache key) means one warmed variant covers
+    every eos value the scheduler can pass."""
+    eng = _tiny_engine(decode_loop_depth=4)
+    eng.warmup()
+    before = decode_loop_step._cache_size()
+
+    B = eng.engine_cfg.max_seqs
+    active = jnp.zeros((B,), bool).at[0].set(True)
+    zeros, ones, zk = jnp.zeros((B,)), jnp.ones((B,)), jnp.zeros((B,), jnp.int32)
+    alloc = PageAllocator(eng.engine_cfg.num_pages)
+    # 3 prompt tokens + one block of 4 appends
+    pages = alloc.allocate("s", pages_needed(3 + 4, eng.page_size))
+    eng.set_page_table_row(0, pages)
+    eng.prefill(0, [3, 7, 11])
+    eng.decode_loop(active, zeros, ones, zk, eos_id=-1)
+    eng.decode_loop(active, zeros, ones, zk, eos_id=7)  # different eos id
+
+    assert decode_loop_step._cache_size() == before, "first block recompiled"
+    # state-neutrality of the warmup block itself is covered by
+    # test_warmup_is_state_neutral running depth 1; check the depth>1 path
+    eng2 = _tiny_engine(decode_loop_depth=4)
+    eng2.warmup()
+    assert np.asarray(eng2.state.context_lens).tolist() == [0, 0]
+    assert np.asarray(eng2.state.page_table).sum() == 0
 
 
 def test_warmup_covers_non_power_of_two_max_seqs():
