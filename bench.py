@@ -80,6 +80,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "jitter per depth")
     p.add_argument("--decode-loop-depths", default="1,4,8",
                    help="comma-separated depths for --decode-loop-sweep")
+    p.add_argument("--session-sweep", action="store_true",
+                   help="multi-turn conversation benchmark of the session "
+                        "KV cache (engine/session_cache.py): per-turn "
+                        "prefill chunks dispatched and TTFT with the cache "
+                        "off (cold, re-prefill the whole history) vs on "
+                        "(resume from the offloaded KV), plus a greedy "
+                        "output identity check")
+    p.add_argument("--session-turns", type=int, default=4,
+                   help="conversation turns for --session-sweep")
     p.add_argument("--tpu-timeout", type=float, default=180.0,
                    help="seconds allowed for TPU backend INIT before the "
                         "child is declared hung (measurement gets "
@@ -131,7 +140,15 @@ def run_worker(args: argparse.Namespace) -> int:
     faulthandler.dump_traceback_later(max(60.0, args.measure_budget - 10.0), exit=True)
 
     work = resolve_workload(args, "tpu" if platform == "tpu" else "cpu")
-    if args.decode_loop_sweep:
+    if args.session_sweep:
+        if args.page_size is None:
+            # page granularity is the resume resolution: the headline 128
+            # would swallow a whole short turn per page at sweep scale
+            work["page_size"] = 32
+        result = measure_session_sweep(
+            attn=args.attn, quant=args.quant or "",
+            kv_quant=args.kv_quant or "", turns=args.session_turns, **work)
+    elif args.decode_loop_sweep:
         depths = tuple(int(d) for d in args.decode_loop_depths.split(","))
         result = measure_decode_loop_sweep(
             attn=args.attn, quant=args.quant or "",
@@ -561,6 +578,147 @@ def measure_decode_loop_sweep(
     }
 
 
+def measure_session_sweep(
+    preset: str, batch: int, prompt_len: int, steps: int, warmup: int,
+    page_size: int, max_seq_len: int, attn: str | None,
+    quant: str = "", kv_quant: str = "", turns: int = 4,
+) -> dict:
+    """Multi-turn conversation benchmark of the session KV cache: one
+    conversation whose every turn's prompt extends the previous turn's
+    prompt + response (the multi-turn chatbot shape — reference
+    main.py re-fetches and re-prefills the whole history per message),
+    measured twice through the REAL scheduler: cache off (cold — prefill
+    from token zero every turn) vs on (resume from the offloaded KV).
+    Reports per-turn prefill chunks dispatched (the metric the cache
+    exists to shrink: cold grows linearly with history, resumed stays
+    ~flat at the new-suffix size) and asserts the two runs' greedy token
+    streams are identical."""
+    import asyncio
+
+    import jax
+    import numpy as np
+
+    from finchat_tpu.engine.engine import InferenceEngine
+    from finchat_tpu.engine.kv_cache import pages_needed
+    from finchat_tpu.engine.sampler import SamplingParams
+    from finchat_tpu.engine.scheduler import ContinuousBatchingScheduler
+    from finchat_tpu.models.llama import PRESETS, init_params
+    from finchat_tpu.ops.dispatch import attention_backend
+    from finchat_tpu.utils.config import EngineConfig
+    from finchat_tpu.utils.metrics import METRICS
+
+    config = PRESETS[preset]
+    attn = attn or attention_backend()
+    suffix_len, n_new = 48, 16  # new user tokens / response tokens per turn
+    chunk = 64
+    total_len = prompt_len + turns * (suffix_len + n_new) + n_new
+    max_seq_len = max(max_seq_len, total_len + page_size)
+    pages_per_seq = pages_needed(max_seq_len, page_size)
+
+    def run_conversation(session_cache_bytes: int):
+        engine_cfg = EngineConfig(
+            max_seqs=2, page_size=page_size,
+            num_pages=2 * pages_per_seq + 8, max_seq_len=max_seq_len,
+            prefill_chunk=chunk,
+            session_cache=session_cache_bytes > 0,
+            session_cache_bytes=session_cache_bytes,
+            kv_quant=kv_quant,
+        )
+        if quant:
+            from finchat_tpu.models.quant import init_quantized_llama_params
+
+            params = init_quantized_llama_params(config, jax.random.key(0))
+        else:
+            params = init_params(config, jax.random.key(0))
+        engine = InferenceEngine(config, params, engine_cfg, attn_backend=attn,
+                                 quant=quant)
+        # eos_id -1: random-weight greedy streams must never stop early, so
+        # every turn generates exactly n_new tokens and runs are comparable
+        scheduler = ContinuousBatchingScheduler(engine, eos_id=-1)
+        rng = np.random.default_rng(0)
+        history = rng.integers(1, config.vocab_size, size=prompt_len).tolist()
+        per_turn: list[dict] = []
+        streams: list[list[int]] = []
+
+        async def go():
+            nonlocal history
+            await scheduler.start()
+            try:
+                for t in range(turns):
+                    prompt = history + rng.integers(
+                        1, config.vocab_size, size=suffix_len
+                    ).tolist()
+                    chunks0 = METRICS.snapshot().get("finchat_prefill_seconds_count", 0)
+                    t0 = time.perf_counter()
+                    handle = await scheduler.submit(
+                        f"turn-{t}-{session_cache_bytes}", prompt,
+                        SamplingParams(temperature=0.0, max_new_tokens=n_new),
+                        conversation_id="bench-conv",
+                    )
+                    tokens, ttft = [], None
+                    while True:
+                        event = await handle.events.get()
+                        if event["type"] == "token":
+                            if ttft is None:
+                                ttft = time.perf_counter() - t0
+                            tokens.append(event["token_id"])
+                        elif event["type"] == "done":
+                            break
+                        else:
+                            raise RuntimeError(f"turn {t} errored: {event}")
+                    chunks1 = METRICS.snapshot().get("finchat_prefill_seconds_count", 0)
+                    per_turn.append({
+                        "turn": t,
+                        "prompt_tokens": len(prompt),
+                        "prefill_chunks": int(chunks1 - chunks0),
+                        "ttft_ms": round(1000 * ttft, 1),
+                    })
+                    streams.append(tokens)
+                    history = prompt + tokens
+            finally:
+                await scheduler.stop()
+
+        asyncio.run(go())
+        return per_turn, streams
+
+    cold_turns, cold_streams = run_conversation(0)
+    restored0 = METRICS.get("finchat_session_cache_restored_tokens_total")
+    warm_turns, warm_streams = run_conversation(64 << 20)
+    restored = int(METRICS.get("finchat_session_cache_restored_tokens_total") - restored0)
+
+    identical = warm_streams == cold_streams
+    saved = [c["prefill_chunks"] - w["prefill_chunks"]
+             for c, w in zip(cold_turns, warm_turns)]
+    for c, w in zip(cold_turns, warm_turns):
+        print(f"[bench] session turn {c['turn']}: prefill chunks "
+              f"{c['prefill_chunks']} cold -> {w['prefill_chunks']} resumed "
+              f"(ttft {c['ttft_ms']} -> {w['ttft_ms']} ms)",
+              file=sys.stderr, flush=True)
+    return {
+        "metric": "session_cache_sweep",
+        "unit": "prefill chunks/turn",
+        "model": preset,
+        "attn": attn,
+        "quant": quant or "bf16",
+        "kv_quant": kv_quant or "off",
+        "page_size": page_size,
+        "prefill_chunk": chunk,
+        "turns": turns,
+        "turn_suffix_tokens": suffix_len,
+        "new_tokens_per_turn": n_new,
+        "cold": cold_turns,
+        "resumed": warm_turns,
+        "chunks_saved_per_turn": saved,
+        "restored_tokens_total": restored,
+        # the acceptance gates: every turn after the first dispatches
+        # strictly fewer prefill chunks resumed than cold, byte-identically
+        "turn2_plus_strictly_fewer": all(s > 0 for s in saved[1:]),
+        "greedy_outputs_identical": identical,
+        "platform": jax.devices()[0].platform,
+        "device": str(jax.devices()[0]),
+    }
+
+
 # --------------------------------------------------------------------------
 # Orchestrator: jax-free; spawns workers, never hangs, always prints JSON.
 # --------------------------------------------------------------------------
@@ -578,6 +736,8 @@ def spawn_worker(args: argparse.Namespace, platform: str, timeout: float) -> dic
     if args.decode_loop_sweep:
         cmd += ["--decode-loop-sweep",
                 "--decode-loop-depths", args.decode_loop_depths]
+    if args.session_sweep:
+        cmd += ["--session-sweep", "--session-turns", str(args.session_turns)]
     print(f"[bench] spawning {platform} worker (timeout {timeout:.0f}s)",
           file=sys.stderr, flush=True)
     try:

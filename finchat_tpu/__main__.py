@@ -36,6 +36,10 @@ def main() -> None:
                    help="tokens per fused decode dispatch (engine "
                         "decode_loop_step); 1 = per-token decode, bench at "
                         "4/8 — also FINCHAT_DECODE_LOOP_DEPTH")
+    p.add_argument("--session-cache-bytes", type=int, default=None,
+                   help="host-RAM byte budget for the session KV cache "
+                        "(engine/session_cache.py); 0 disables cross-turn "
+                        "KV resume — also FINCHAT_SESSION_CACHE_BYTES")
     args = p.parse_args()
 
     overrides: dict = {}
@@ -45,6 +49,8 @@ def main() -> None:
         overrides["serve.port"] = args.port
     if args.decode_loop_depth is not None:
         overrides["engine.decode_loop_depth"] = args.decode_loop_depth
+    if args.session_cache_bytes is not None:
+        overrides["engine.session_cache_bytes"] = args.session_cache_bytes
     cfg = load_config(args.config, overrides)
 
     from finchat_tpu.serve.app import build_app

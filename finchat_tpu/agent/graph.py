@@ -221,12 +221,23 @@ class LLMAgent:
         # handled by the generator's token-level head+tail splice
         return text
 
+    @staticmethod
+    def _session_key(state: AgentState, role: str) -> str | None:
+        """Session-KV-cache key for one LLM role. The two roles render
+        DIFFERENT prompts for the same conversation, so they must not share
+        a key — a shared one would cross-truncate on every turn (the
+        matcher sees the other role's prompt as a divergent history)."""
+        if not state.conversation_id:
+            return None
+        return f"{state.conversation_id}#{role}"
+
     # --- nodes -----------------------------------------------------------
     async def _decide_retrieval_node(self, state: AgentState) -> AgentState:
         """Node 1: decide whether transaction retrieval is needed."""
         logger.info("Deciding if transaction retrieval is needed")
         decision_text = await self.tool_generator.generate(
-            self._tool_prompt_text(state), self.tool_sampling
+            self._tool_prompt_text(state), self.tool_sampling,
+            conversation_id=self._session_key(state, "tool"),
         )
         tool_call = parse_tool_decision(decision_text)
         if tool_call is not None:
@@ -285,7 +296,8 @@ class LLMAgent:
         """Node 3: generate the final response (non-streaming graph path)."""
         logger.info("Generating final response")
         state.final_response = await self.response_generator.generate(
-            self._response_prompt_text(state), self.response_sampling
+            self._response_prompt_text(state), self.response_sampling,
+            conversation_id=self._session_key(state, "resp"),
         )
         logger.info("Final response generated")
         return state
@@ -304,12 +316,14 @@ class LLMAgent:
         user_id: str,
         user_context: str = "",
         chat_history: list[ChatMessage] | None = None,
+        conversation_id: str | None = None,
     ) -> dict[str, Any]:
         """Batch path through the compiled graph (reference llm_agent.py:175)."""
         logger.info("Processing query for user %s: %s", user_id, user_query)
         state = AgentState(
             user_query=user_query,
             user_id=user_id,
+            conversation_id=conversation_id,
             user_context=user_context,
             chat_history=list(chat_history or []),
             tool_calls=deque(),
@@ -328,6 +342,7 @@ class LLMAgent:
         user_id: str,
         user_context: str = "",
         chat_history: list[ChatMessage] | None = None,
+        conversation_id: str | None = None,
     ) -> AsyncGenerator[dict[str, Any], None]:
         """Streaming path with status events (reference llm_agent.py:202-252);
         event shapes/messages kept verbatim."""
@@ -337,6 +352,7 @@ class LLMAgent:
         state = AgentState(
             user_query=user_query,
             user_id=user_id,
+            conversation_id=conversation_id,
             user_context=user_context,
             chat_history=list(chat_history or []),
             tool_calls=deque(),
@@ -361,7 +377,8 @@ class LLMAgent:
         yield {"type": "status", "message": "Generating response..."}
 
         async for chunk in self.response_generator.stream(
-            self._response_prompt_text(state), self.response_sampling
+            self._response_prompt_text(state), self.response_sampling,
+            conversation_id=self._session_key(state, "resp"),
         ):
             if chunk:
                 yield {"type": "response_chunk", "content": chunk}

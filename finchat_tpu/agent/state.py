@@ -24,6 +24,9 @@ class ToolCall:
 class AgentState:
     user_query: str
     user_id: str
+    # session KV cache key (engine/session_cache.py): turns of the same
+    # conversation resume each other's prefilled KV; None = no reuse
+    conversation_id: str | None = None
     user_context: str = ""
     chat_history: list[ChatMessage] = field(default_factory=list)
     tool_calls: deque[ToolCall] = field(default_factory=deque)

@@ -821,6 +821,31 @@ class InferenceEngine:
             last_tokens=self.state.last_tokens.at[idx].set(0),
         )
 
+    def offload_pages(self, page_ids: list[int]):
+        """Snapshot physical pages device→host (all layers, K+V+scales) for
+        the session KV cache. Blocks until the D2H copy lands — the caller
+        is about to free these pages (see kv_cache.gather_pages_host)."""
+        from finchat_tpu.engine.kv_cache import gather_pages_host
+
+        s = self.state
+        return gather_pages_host(
+            s.k_pages, s.v_pages, s.k_scales, s.v_scales, page_ids
+        )
+
+    def restore_pages(self, page_ids: list[int], host: tuple) -> None:
+        """Write a host snapshot back into freshly allocated pages (session
+        cache resume). One XLA scatter per turn — off the jitted hot path."""
+        from finchat_tpu.engine.kv_cache import scatter_pages_device
+
+        s = self.state
+        k_pages, v_pages, k_scales, v_scales = scatter_pages_device(
+            s.k_pages, s.v_pages, s.k_scales, s.v_scales, page_ids, host
+        )
+        self.state = dataclasses.replace(
+            self.state, k_pages=k_pages, v_pages=v_pages,
+            k_scales=k_scales, v_scales=v_scales,
+        )
+
     def _use_ring_prefill(self, prompt_len: int) -> bool:
         return (
             self.mesh is not None

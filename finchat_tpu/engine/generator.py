@@ -28,9 +28,18 @@ class GenerationError(RuntimeError):
 
 
 class TextGenerator(Protocol):
-    async def stream(self, prompt: str, sampling: SamplingParams) -> AsyncIterator[str]: ...
+    # ``conversation_id`` keys the engine's cross-turn session KV cache
+    # (engine/session_cache.py); None = no cross-turn reuse. Non-engine
+    # implementations may ignore it.
+    async def stream(
+        self, prompt: str, sampling: SamplingParams,
+        conversation_id: str | None = None,
+    ) -> AsyncIterator[str]: ...
 
-    async def generate(self, prompt: str, sampling: SamplingParams) -> str: ...
+    async def generate(
+        self, prompt: str, sampling: SamplingParams,
+        conversation_id: str | None = None,
+    ) -> str: ...
 
 
 class EngineGenerator:
@@ -79,7 +88,10 @@ class EngineGenerator:
             raise
         return TokenConstraint(vocab)
 
-    async def stream(self, prompt: str, sampling: SamplingParams) -> AsyncIterator[str]:
+    async def stream(
+        self, prompt: str, sampling: SamplingParams,
+        conversation_id: str | None = None,
+    ) -> AsyncIterator[str]:
         prompt_ids = self.tokenizer.encode(prompt, add_bos=True)
         budget = self.prompt_budget(sampling)
         if len(prompt_ids) > budget:
@@ -96,7 +108,10 @@ class EngineGenerator:
             prompt_ids = prompt_ids[:head] + prompt_ids[-tail:]
         seq_id = f"seq-{next(self._ids)}"
         constraint = await self._make_constraint(sampling.grammar) if sampling.grammar else None
-        handle = await self.scheduler.submit(seq_id, prompt_ids, sampling, constraint=constraint)
+        handle = await self.scheduler.submit(
+            seq_id, prompt_ids, sampling, constraint=constraint,
+            conversation_id=conversation_id,
+        )
         decoder = IncrementalDecoder(self.tokenizer)
         try:
             while True:
@@ -116,8 +131,15 @@ class EngineGenerator:
             if not handle.finished:
                 self.scheduler.cancel(handle)
 
-    async def generate(self, prompt: str, sampling: SamplingParams) -> str:
-        return "".join([piece async for piece in self.stream(prompt, sampling)])
+    async def generate(
+        self, prompt: str, sampling: SamplingParams,
+        conversation_id: str | None = None,
+    ) -> str:
+        return "".join([
+            piece async for piece in self.stream(
+                prompt, sampling, conversation_id=conversation_id
+            )
+        ])
 
 
 class StubGenerator:
@@ -147,7 +169,10 @@ class StubGenerator:
                 return response
         return self.default
 
-    async def stream(self, prompt: str, sampling: SamplingParams) -> AsyncIterator[str]:
+    async def stream(
+        self, prompt: str, sampling: SamplingParams,
+        conversation_id: str | None = None,
+    ) -> AsyncIterator[str]:
         self.calls.append(prompt)
         if self.fail_with is not None:
             raise GenerationError(self.fail_with)
@@ -158,5 +183,8 @@ class StubGenerator:
                 await asyncio.sleep(self.chunk_delay)
             yield piece + (" " if i < len(pieces) - 1 else "")
 
-    async def generate(self, prompt: str, sampling: SamplingParams) -> str:
+    async def generate(
+        self, prompt: str, sampling: SamplingParams,
+        conversation_id: str | None = None,
+    ) -> str:
         return "".join([piece async for piece in self.stream(prompt, sampling)])

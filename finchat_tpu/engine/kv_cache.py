@@ -238,6 +238,63 @@ def scatter_kv_chunk(
     return k_pages, v_pages
 
 
+def gather_pages_host(
+    k_pages: Any,
+    v_pages: Any,
+    k_scales: Any,
+    v_scales: Any,
+    page_ids: list[int],
+) -> tuple[Any, Any, Any | None, Any | None]:
+    """Copy a set of physical pages device→host across all layers: returns
+    ``(k [L, n, PS, row], v, k_scales | None, v_scales | None)`` as numpy.
+
+    Session-cache OFFLOAD path (engine/session_cache.py). Deliberately NOT
+    jitted and deliberately synchronous: the gather rides the ordinary
+    dispatch stream, so it serializes AFTER every already-dispatched step
+    that might still append into these pages, and ``device_get`` blocks
+    until the copy lands — the caller frees the pages immediately after,
+    so returning before the read completed would race the next sequence's
+    writes. Per-turn cost, never on the per-token hot path."""
+    import numpy as np
+
+    ids = jnp.asarray(page_ids, jnp.int32)
+    quantized = k_pages.dtype == jnp.int8
+    k = np.asarray(jax.device_get(jnp.take(k_pages, ids, axis=1)))
+    v = np.asarray(jax.device_get(jnp.take(v_pages, ids, axis=1)))
+    ks = vs = None
+    if quantized:
+        ks = np.asarray(jax.device_get(jnp.take(k_scales, ids, axis=1)))
+        vs = np.asarray(jax.device_get(jnp.take(v_scales, ids, axis=1)))
+    return k, v, ks, vs
+
+
+def scatter_pages_device(
+    k_pages: Any,
+    v_pages: Any,
+    k_scales: Any,
+    v_scales: Any,
+    page_ids: list[int],
+    host: tuple,
+) -> tuple[Any, Any, Any, Any]:
+    """Write host page snapshots (``gather_pages_host`` layout, possibly a
+    leading slice of one) back into freshly allocated physical pages.
+
+    Session-cache RESTORE path. An XLA scatter — one full-cache copy per
+    restore, amortized over a whole turn (the same trade ``scatter_kv_chunk``
+    makes per prefill chunk); never called from a jitted step."""
+    ids = jnp.asarray(page_ids, jnp.int32)
+    k, v, ks, vs = host
+    n = len(page_ids)
+    assert k.shape[1] >= n, f"snapshot holds {k.shape[1]} pages, need {n}"
+    k_pages = k_pages.at[:, ids].set(jnp.asarray(k[:, :n]))
+    v_pages = v_pages.at[:, ids].set(jnp.asarray(v[:, :n]))
+    if k_pages.dtype == jnp.int8:
+        assert ks is not None and vs is not None, "int8 cache needs scale snapshots"
+        k_scales = k_scales.at[:, ids].set(jnp.asarray(ks[:, :n]))
+        v_scales = v_scales.at[:, ids].set(jnp.asarray(vs[:, :n]))
+    return k_pages, v_pages, k_scales, v_scales
+
+
 def quantize_kv_rows(x: Any, n_kv: int) -> tuple[Any, Any]:
     """Per-token-per-head symmetric int8 quantization of KV rows.
 

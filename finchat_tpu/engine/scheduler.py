@@ -34,6 +34,13 @@ model replica:
   single-step (mirroring the SPEC_MISS_DEMOTE machinery) and rejoin
   blocks when eligibility returns; slots that finish mid-block free-run
   into the trash page and their tail iterations are counted as waste.
+- Session KV cache (engine/session_cache.py): sequences submitted with a
+  ``conversation_id`` snapshot their KV pages device→host when they retire
+  normally (eos/length, before the pages are freed) and the conversation's
+  next turn resumes from the longest matching page-whole token prefix —
+  restored pages + prefill starting at the matched offset — instead of
+  re-prefilling the whole history. Composes with the shared-prefix entries
+  below: a cached head is referenced (refcounted), never copied.
 - Per-sequence failure isolation (SURVEY §5.3): an errored sequence is
   evicted, its pages freed, an error event emitted on its stream, and the
   engine keeps serving the others. The process-level watchdog of the
@@ -78,9 +85,23 @@ class SequenceHandle:
     prompt_ids: list[int]
     sampling: SamplingParams
     constraint: TokenConstraint | None = None
+    # session KV cache key: turns of the same conversation resume each
+    # other's KV (engine/session_cache.py); None = no cross-turn caching
+    conversation_id: str | None = None
     events: asyncio.Queue = field(default_factory=asyncio.Queue)
     slot: int = -1
     prefill_pos: int = 0  # prompt tokens already prefilled
+    # full logical→physical page list assigned at admission (shared head
+    # pages first, then owned pages) — retirement offload slices it
+    page_list: list[int] = field(default_factory=list)
+    # tokens covered by READ-ONLY referenced head pages (shared-prefix or
+    # session-restored head); the slot's own writes start past this
+    shared_len: int = 0
+    # tokens whose KV was restored from a session-cache snapshot at
+    # admission (0 = cold). Pages covering [shared_len, resumed_len) were
+    # copied host→device and never rewritten, so retirement offload reuses
+    # the previous entry's host bytes for them instead of a fresh D2H copy
+    resumed_len: int = 0
     generated: int = 0
     # prompt + delivered tokens — the prompt-lookup draft source when
     # speculative decoding is on (engine/spec.py); maintained by _deliver
@@ -222,9 +243,30 @@ class ContinuousBatchingScheduler:
         self._prefixes: list[_PrefixEntry] = []
         self._n_prefixes_ever = 0  # unique allocator owner ids
         self._prefix_jobs: deque[_PrefixJob] = deque()
+        # log the top_k clamp once per distinct requested value — a
+        # misconfigured client retries per message, and per-request warnings
+        # would flood the log under load (the clamp itself still applies and
+        # is counted in finchat_top_k_clamped_total)
+        self._top_k_clamp_warned: set[int] = set()
+        # session KV cache (engine/session_cache.py): host-RAM tier keyed by
+        # conversation_id; None = disabled. The on_drop hook is where entry
+        # references on shared-prefix pages are released.
+        self.session_cache = None
+        if cfg.session_cache and cfg.session_cache_bytes > 0:
+            from finchat_tpu.engine.session_cache import SessionKVCache
+
+            self.session_cache = SessionKVCache(
+                cfg.session_cache_bytes, page_size=cfg.page_size,
+                on_drop=self._session_drop,
+            )
 
     # --- public API -----------------------------------------------------
     async def start(self) -> None:
+        # rebind to the CURRENT loop: an Event pins itself to the loop that
+        # first awaits it, so a stop/start cycle across asyncio.run calls
+        # (tests, serving restarts) would otherwise raise "bound to a
+        # different event loop"
+        self._wakeup = asyncio.Event()
         self._running = True
         self._task = asyncio.create_task(self._loop())
 
@@ -242,6 +284,7 @@ class ContinuousBatchingScheduler:
         prompt_ids: list[int],
         sampling: SamplingParams,
         constraint: TokenConstraint | None = None,
+        conversation_id: str | None = None,
     ) -> SequenceHandle:
         if not prompt_ids:
             raise ValueError("empty prompt")
@@ -254,16 +297,23 @@ class ContinuousBatchingScheduler:
         from finchat_tpu.engine.sampler import CANDIDATES
 
         if sampling.top_k > CANDIDATES:
-            logger.warning(
-                "sequence %s: top_k=%d exceeds the sampler candidate cap %d; clamping "
-                "(see SamplingParams truncation contract)",
-                seq_id, sampling.top_k, CANDIDATES,
-            )
+            if sampling.top_k not in self._top_k_clamp_warned:
+                self._top_k_clamp_warned.add(sampling.top_k)
+                logger.warning(
+                    "sequence %s: top_k=%d exceeds the sampler candidate cap %d; "
+                    "clamping (logged once per distinct top_k — further requests "
+                    "are clamped silently and counted in "
+                    "finchat_top_k_clamped_total; see SamplingParams truncation "
+                    "contract)",
+                    seq_id, sampling.top_k, CANDIDATES,
+                )
+            METRICS.inc("finchat_top_k_clamped_total")
             import dataclasses as _dc
 
             sampling = _dc.replace(sampling, top_k=CANDIDATES)
         handle = SequenceHandle(
-            seq_id=seq_id, prompt_ids=list(prompt_ids), sampling=sampling, constraint=constraint
+            seq_id=seq_id, prompt_ids=list(prompt_ids), sampling=sampling,
+            constraint=constraint, conversation_id=conversation_id,
         )
         self.pending.append(handle)
         METRICS.set_gauge("finchat_queue_depth", len(self.pending))
@@ -370,9 +420,17 @@ class ContinuousBatchingScheduler:
         """Stop matching every registered prefix (the caller is about to
         register fresh heads — e.g. the embedded date rolled over). Pages
         free immediately when unreferenced, else when the last in-flight
-        sequence using them releases (_release)."""
+        sequence using them releases (_release). Session-cache entries
+        referencing a retired head are purged here too: post-rollover
+        prompts diverge inside the head, so such an entry can never resume
+        again — keeping it would pin the retired head's device pages for
+        as long as an idle conversation stays under the host budget."""
         for entry in self._prefixes:
             entry.retired = True
+        if self.session_cache is not None:
+            self.session_cache.discard_if(
+                lambda e: e.prefix_entry is not None and e.prefix_entry.retired
+            )
         self._reap_prefixes()
 
     def _reap_prefixes(self) -> None:
@@ -410,11 +468,14 @@ class ContinuousBatchingScheduler:
     def _admit(self) -> None:
         admitted: dict[int, list[int]] = {}
         ctx_rows: dict[int, int] = {}
+        page = self.engine.page_size
         while self.pending and self.free_slots:
             handle = self.pending[0]
             total = pages_needed(
-                len(handle.prompt_ids) + handle.sampling.max_new_tokens, self.engine.page_size
+                len(handle.prompt_ids) + handle.sampling.max_new_tokens, page
             )
+            if total > self.engine.max_pages_per_seq:
+                break  # head-of-line waits for pages (rejected at submit anyway)
             # a MONOLITHIC ring prefill assumes position 0, so a prefix
             # hit would force such a prompt onto the chunked path —
             # trading away the activation-memory safety the ring exists
@@ -422,29 +483,93 @@ class ContinuousBatchingScheduler:
             # tokens > 0) composes: the first segment simply starts at
             # shared_len with the cached head folded as prefix, so long
             # RAG prompts keep the system-head TTFT saving.
-            if (self.engine._use_ring_prefill(len(handle.prompt_ids))
-                    and self.engine.ring_segment_tokens() == 0):
+            ring = self.engine._use_ring_prefill(len(handle.prompt_ids))
+            if ring and self.engine.ring_segment_tokens() == 0:
                 entry, shared_len = None, 0
             else:
                 entry, shared_len = self._match_prefix(handle.prompt_ids)
-            shared_pages = entry.pages[: shared_len // self.engine.page_size] if entry else []
-            need = total - len(shared_pages)
-            if total > self.engine.max_pages_per_seq or not self.allocator.can_allocate(need):
+            # session tier: a per-conversation resume takes over whenever it
+            # matches deeper than the constant shared head (it contains the
+            # head as its own leading pages). Ring-eligible prompts keep the
+            # SP prefill path untouched — only the head composition above
+            # applies there.
+            s_entry, s_matched = (None, 0)
+            session_eligible = (
+                self.session_cache is not None and handle.conversation_id and not ring
+            )
+            if session_eligible:
+                s_entry, s_matched = self.session_cache.match(
+                    handle.conversation_id, handle.prompt_ids
+                )
+                if s_entry is None or s_matched <= shared_len:
+                    s_entry, s_matched = None, 0
+            if s_entry is not None:
+                # shared head pages referenced (never copied); the pages
+                # past the head restore from the host snapshot below
+                head_pages = s_entry.prefix_pages[: min(s_matched, s_entry.prefix_len) // page]
+                n_restore = s_entry.own_pages_for(s_matched, page)
+                ref_entry = s_entry.prefix_entry if head_pages else None
+                resume_pos = s_matched
+            else:
+                head_pages = entry.pages[: shared_len // page] if entry else []
+                n_restore = 0
+                ref_entry = entry
+                resume_pos = shared_len
+            need = total - len(head_pages)
+            if not self.allocator.can_allocate(need):
                 break  # head-of-line waits for pages
             self.pending.popleft()
             slot = self.free_slots.pop()
             pages = self.allocator.allocate(handle.seq_id, need)
-            # shared prefix pages lead (logical pages 0..): the slot reads
-            # them read-only — its own writes all land at positions >=
-            # shared_len, i.e. in its own pages
-            admitted[slot] = shared_pages + pages
-            if entry:
-                entry.refs += 1
-                handle.prefix_entry = entry
-                ctx_rows[slot] = shared_len
-                handle.prefill_pos = shared_len
-                METRICS.inc("finchat_prefix_hits_total")
-                METRICS.inc("finchat_prefix_tokens_saved_total", shared_len)
+            if n_restore:
+                try:
+                    with Timer(METRICS, "finchat_session_restore_seconds"):
+                        self.engine.restore_pages(pages[:n_restore], s_entry.snap)
+                    METRICS.inc("finchat_session_cache_restored_tokens_total",
+                                resume_pos)
+                except Exception as e:
+                    # a failed restore must not kill the stream OR leak the
+                    # allocation: return the pages cleanly and fall back to
+                    # a cold start through the plain shared-prefix plan
+                    logger.error("session cache restore failed for %s: %s",
+                                 handle.seq_id, e)
+                    self.allocator.free(handle.seq_id, pages)
+                    s_entry = None  # the admission below is the prefix plan
+                    head_pages = entry.pages[: shared_len // page] if entry else []
+                    ref_entry = entry
+                    resume_pos = shared_len
+                    need = total - len(head_pages)
+                    n_restore = 0
+                    if not self.allocator.can_allocate(need):
+                        # cold plan needs more pages than the resume did:
+                        # requeue at the head and wait like any other
+                        self.pending.appendleft(handle)
+                        self.free_slots.append(slot)
+                        break
+                    pages = self.allocator.allocate(handle.seq_id, need)
+            if session_eligible:
+                # counted only for an admission that actually went through
+                # its plan — a page-starved head-of-line retry or a failed
+                # restore (demoted to a cold start above) must not inflate
+                # the hit rate
+                METRICS.inc("finchat_session_cache_hits_total" if s_entry is not None
+                            else "finchat_session_cache_misses_total")
+            # shared/restored head pages lead (logical pages 0..): the slot
+            # reads them read-only — its own writes all land at positions >=
+            # resume_pos, i.e. in its own pages
+            admitted[slot] = head_pages + pages
+            handle.page_list = admitted[slot]
+            handle.shared_len = len(head_pages) * page
+            handle.resumed_len = resume_pos if s_entry is not None else 0
+            if ref_entry is not None:
+                ref_entry.refs += 1
+                handle.prefix_entry = ref_entry
+            if resume_pos:
+                ctx_rows[slot] = resume_pos
+                handle.prefill_pos = resume_pos
+                if s_entry is None:
+                    METRICS.inc("finchat_prefix_hits_total")
+                    METRICS.inc("finchat_prefix_tokens_saved_total", shared_len)
             handle.slot = slot
             handle.span.mark("admitted")
             if handle.constraint is None:
@@ -493,7 +618,87 @@ class ContinuousBatchingScheduler:
                 handle.prefix_entry = None
                 self._reap_prefixes()
 
+    def _session_drop(self, entry) -> None:
+        """Session-cache ``on_drop`` hook (LRU eviction, replacement, or
+        divergence truncation to nothing): release the entry's reference on
+        its shared-prefix head so retirement can finally free those pages."""
+        if entry.prefix_entry is not None:
+            entry.prefix_entry.refs -= 1
+            entry.prefix_entry = None
+            self._reap_prefixes()
+
+    def _maybe_offload(self, handle: SequenceHandle) -> None:
+        """Snapshot a normally-retiring sequence's KV into the session cache
+        (device→host) BEFORE its pages are freed. Whole pages only — the
+        matcher is page-granular, so a partial tail page could never be
+        resumed. The D2H copy blocks (engine.offload_pages) by design: the
+        pages are returned to the allocator the moment this returns, and an
+        async copy would race the next sequence's writes into them."""
+        cache = self.session_cache
+        if cache is None or not handle.conversation_id or handle.slot < 0:
+            return
+        if handle.prefill_pos < len(handle.prompt_ids) or not handle.generated:
+            return  # never reached decode; nothing coherent to keep
+        page = self.engine.page_size
+        # KV-cached tokens: prompt + generated minus the last delivered
+        # token, whose KV append belongs to the step that was never consumed
+        context = len(handle.history) - 1
+        n_tok = (context // page) * page
+        if n_tok <= 0:
+            return
+        shared = min(handle.shared_len, n_tok)
+        # a shared head without a refcounted entry would store device page
+        # ids nobody protects — use-after-free; admission guarantees the pair
+        assert shared == 0 or handle.prefix_entry is not None
+        # incremental offload: pages covering [shared, resumed_len) were
+        # restored from the previous entry's snapshot at admission and never
+        # rewritten (the slot's writes start at resumed_len), so reuse those
+        # host bytes — without this every retirement re-copies the WHOLE
+        # history D2H and the per-turn cost grows linearly again
+        prev = cache.get(handle.conversation_id)
+        reuse_pages = 0
+        if (prev is not None and prev.snap is not None
+                and prev.prefix_len == shared and handle.resumed_len > shared):
+            m = min(handle.resumed_len, n_tok, prev.n_tokens)
+            reuse_pages = (m - shared) // page
+            if reuse_pages and not np.array_equal(
+                prev.token_ids[shared : shared + reuse_pages * page],
+                np.asarray(handle.history[shared : shared + reuse_pages * page], np.int32),
+            ):
+                reuse_pages = 0  # entry replaced by a different stream since
+        own_ids = handle.page_list[shared // page + reuse_pages : n_tok // page]
+        try:
+            with Timer(METRICS, "finchat_session_offload_seconds"):
+                snap_new = self.engine.offload_pages(own_ids) if own_ids else None
+        except Exception as e:  # cache is an optimization; never fail eviction
+            logger.error("session cache offload failed for %s: %s", handle.seq_id, e)
+            return
+        from finchat_tpu.engine.session_cache import SessionEntry, concat_snaps
+
+        entry = SessionEntry(
+            conversation_id=handle.conversation_id,
+            token_ids=np.asarray(handle.history[:n_tok], np.int32),
+            prefix_entry=handle.prefix_entry if shared else None,
+            prefix_pages=list(handle.page_list[: shared // page]),
+            prefix_len=shared,
+            snap=concat_snaps(prev.snap if reuse_pages else None, reuse_pages, snap_new),
+        )
+        # reference the shared head BEFORE put(): put may drop an older
+        # entry holding the same (possibly retired) head, and a momentary
+        # refs==0 would free pages the new entry is about to point at
+        if entry.prefix_entry is not None:
+            entry.prefix_entry.refs += 1
+        if cache.put(entry):
+            METRICS.inc("finchat_session_cache_offloaded_pages_total", len(own_ids))
+        elif entry.prefix_entry is not None:
+            entry.prefix_entry.refs -= 1
+            self._reap_prefixes()
+
     def _evict(self, handle: SequenceHandle, reason: str, error: str | None = None) -> None:
+        if error is None and reason in ("eos", "length"):
+            # normal retirement: the sequence's KV is a coherent prefix of
+            # this conversation's next turn — offload before pages free
+            self._maybe_offload(handle)
         self._release(handle)
         if error is not None:
             handle.finished = True

@@ -1,0 +1,275 @@
+"""Session KV cache: a byte-budgeted host-RAM tier for cross-turn prefix resume.
+
+The reference is a multi-turn chatbot whose every Kafka message re-fetches the
+whole conversation history and re-prefills it from token zero
+(serve/app.py process_message), so turn-N TTFT grows linearly with history
+even though the engine computed that exact KV last turn. The shared-prefix
+entries (scheduler ``_PrefixEntry``) only cover the constant system-prompt
+head shared by ALL conversations; this module adds the per-conversation tier
+below it — the hierarchical KV management that serving stacks built on paged
+attention standardize on (Ragged Paged Attention, arXiv:2604.15464; long-
+sequence state streaming, SnapStream, arXiv:2511.03092):
+
+- OFFLOAD: when a sequence retires normally (eos/length), the scheduler
+  snapshots its KV pages device→host (``InferenceEngine.offload_pages``)
+  BEFORE the pages are freed, keyed by ``conversation_id``.
+- RESUME: when the conversation's next turn arrives, admission matches the
+  new prompt against the stored token stream — longest common token prefix,
+  floored to page granularity — allocates fresh device pages, copies the
+  matched pages host→device (``InferenceEngine.restore_pages``), and starts
+  prefill at the matched offset.
+- DIVERGENCE TRUNCATION: a turn whose history was edited (or re-rendered
+  differently) matches only up to the divergence point; the entry is
+  truncated there so stale KV can never be served.
+- COMPOSITION with the shared-prefix cache: an entry whose sequence rode a
+  refcounted ``_PrefixEntry`` head records those device pages BY REFERENCE
+  (holding a ref so retirement cannot free them) and snapshots only the
+  sequence's OWN pages — the constant head is never copied to host and
+  never duplicated on restore.
+- LRU under a byte budget: host bytes are the sum of the entries' own-page
+  snapshots; inserting past ``budget_bytes`` evicts least-recently-used
+  conversations first.
+
+Ownership contract (the allocator invariants of SURVEY §5.2 are untouched):
+the cache NEVER owns device pages. Snapshots are host copies taken while the
+retiring sequence still owns its pages; restores write into pages freshly
+allocated to (and owned by) the admitted sequence. The only device pages an
+entry points at are the shared-prefix head's, which stay owned by their
+``__prefix_*__`` owner and are protected by the entry's reference count.
+
+Everything here runs on the scheduler's host path (admission / retirement),
+never inside a jitted step — the D2H/H2D copies are per-turn costs, not
+per-token ones.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import numpy as np
+
+from finchat_tpu.utils.logging import get_logger
+from finchat_tpu.utils.metrics import METRICS
+
+logger = get_logger(__name__)
+
+# Snapshot layout throughout this module: a (k, v, k_scales | None,
+# v_scales | None) tuple of host arrays, each [L, n_pages, ...] — the
+# gather_pages_host / scatter_pages_device contract (engine/kv_cache.py).
+
+
+def _snap_nbytes(snap: tuple | None) -> int:
+    if snap is None:
+        return 0
+    return sum(int(a.nbytes) for a in snap if a is not None)
+
+
+def concat_snaps(head: tuple | None, n_head_pages: int, tail: tuple | None) -> tuple | None:
+    """The first ``n_head_pages`` pages of ``head`` followed by all of
+    ``tail`` — the incremental-offload splice: a retiring turn reuses the
+    previous entry's host bytes for pages it restored (and never rewrote)
+    and only the pages written this turn arrive as a fresh D2H ``tail``.
+    Always copies, so the result never aliases the (soon-dropped) head."""
+    if n_head_pages == 0 or head is None:
+        return tail
+    sliced = tuple(a[:, :n_head_pages] if a is not None else None for a in head)
+    if tail is None:
+        return tuple(
+            np.ascontiguousarray(a) if a is not None else None for a in sliced
+        )
+    return tuple(
+        np.concatenate([a, b], axis=1) if a is not None else None
+        for a, b in zip(sliced, tail)
+    )
+
+
+def _slice_snap(snap: tuple | None, n_pages: int) -> tuple | None:
+    """First ``n_pages`` pages of a snapshot, compacted so truncation
+    actually releases host RAM (a view would pin the full buffer)."""
+    if snap is None or n_pages == 0:
+        return None
+    return tuple(
+        np.ascontiguousarray(a[:, :n_pages]) if a is not None else None
+        for a in snap
+    )
+
+
+@dataclass
+class SessionEntry:
+    """One retired conversation's resumable KV.
+
+    ``token_ids`` holds the ``n_tokens`` tokens whose KV the entry covers —
+    always a whole-page multiple, split as ``[0, prefix_len)`` living in the
+    referenced shared-prefix pages and ``[prefix_len, n_tokens)`` in the
+    host snapshot. ``prefix_entry`` (a scheduler ``_PrefixEntry`` or None)
+    carries one reference held for the entry's lifetime; the cache's
+    ``on_drop`` callback is where the scheduler releases it.
+    """
+
+    conversation_id: str
+    token_ids: np.ndarray  # int32 [n_tokens]
+    prefix_entry: Any | None = None
+    prefix_pages: list[int] = field(default_factory=list)  # device page ids, referenced
+    prefix_len: int = 0  # tokens covered by prefix_pages (page multiple)
+    snap: tuple | None = None  # host page arrays covering [prefix_len, n_tokens)
+
+    @property
+    def n_tokens(self) -> int:
+        return len(self.token_ids)
+
+    @property
+    def nbytes(self) -> int:
+        return _snap_nbytes(self.snap)
+
+    def own_pages_for(self, matched: int, page_size: int) -> int:
+        """How many snapshot pages a ``matched``-token resume restores."""
+        return max(0, matched - self.prefix_len) // page_size
+
+
+class SessionKVCache:
+    """Host-RAM LRU of ``SessionEntry`` keyed by conversation id.
+
+    Single-task by design (the scheduler loop is the only caller), so no
+    locking; the byte budget counts host snapshot bytes only — referenced
+    shared-prefix pages live in device HBM under their own owner and are
+    already accounted there.
+    """
+
+    def __init__(self, budget_bytes: int, page_size: int,
+                 on_drop: Callable[[SessionEntry], None] | None = None):
+        assert budget_bytes > 0 and page_size > 0
+        self.budget_bytes = budget_bytes
+        self.page_size = page_size
+        self._on_drop = on_drop
+        self._entries: OrderedDict[str, SessionEntry] = OrderedDict()
+        self._resident_bytes = 0
+        self._publish_gauges()
+
+    # --- introspection ---------------------------------------------------
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def resident_bytes(self) -> int:
+        return self._resident_bytes
+
+    def get(self, conversation_id: str) -> SessionEntry | None:
+        return self._entries.get(conversation_id)
+
+    def _publish_gauges(self) -> None:
+        METRICS.set_gauge("finchat_session_cache_resident_bytes", self._resident_bytes)
+        METRICS.set_gauge("finchat_session_cache_entries", len(self._entries))
+
+    # --- write path ------------------------------------------------------
+    def put(self, entry: SessionEntry) -> bool:
+        """Insert (replacing any previous entry for the conversation),
+        then LRU-evict others until the byte budget holds. Returns False —
+        and drops nothing — when the entry alone exceeds the budget."""
+        if entry.nbytes > self.budget_bytes:
+            logger.warning(
+                "session cache: entry for %s (%d bytes) exceeds budget %d; not stored",
+                entry.conversation_id, entry.nbytes, self.budget_bytes,
+            )
+            return False
+        old = self._entries.pop(entry.conversation_id, None)
+        if old is not None:
+            self._drop(old)
+        self._entries[entry.conversation_id] = entry
+        self._resident_bytes += entry.nbytes
+        while self._resident_bytes > self.budget_bytes:
+            victim_id, victim = next(iter(self._entries.items()))
+            del self._entries[victim_id]
+            self._drop(victim)
+            METRICS.inc("finchat_session_cache_evictions_total")
+            logger.debug("session cache: evicted %s (LRU, %d bytes)",
+                         victim_id, victim.nbytes)
+        self._publish_gauges()
+        return True
+
+    def discard(self, conversation_id: str) -> None:
+        entry = self._entries.pop(conversation_id, None)
+        if entry is not None:
+            self._drop(entry)
+            self._publish_gauges()
+
+    def clear(self) -> None:
+        for entry in list(self._entries.values()):
+            self._drop(entry)
+        self._entries.clear()
+        self._publish_gauges()
+
+    def discard_if(self, pred: Callable[[SessionEntry], bool]) -> int:
+        """Drop every entry matching ``pred``; returns how many. Used by
+        prefix retirement: an entry referencing a retired head pins that
+        head's DEVICE pages (the whole point of the refcount), but after a
+        rollover the head can never match again — idle conversations would
+        otherwise pin retired-head HBM indefinitely."""
+        victims = [e for e in self._entries.values() if pred(e)]
+        for entry in victims:
+            del self._entries[entry.conversation_id]
+            self._drop(entry)
+        if victims:
+            self._publish_gauges()
+        return len(victims)
+
+    def _drop(self, entry: SessionEntry) -> None:
+        self._resident_bytes -= entry.nbytes
+        entry.snap = None
+        if self._on_drop is not None:
+            self._on_drop(entry)
+
+    # --- read path -------------------------------------------------------
+    def match(self, conversation_id: str, prompt_ids: list[int]) -> tuple[SessionEntry | None, int]:
+        """Longest resumable prefix of ``prompt_ids`` held for this
+        conversation: the common token prefix with the entry, floored to
+        whole pages, capped so at least one prompt token remains to prefill
+        (the admission commit needs real last-token logits — same rule as
+        the shared-prefix matcher). A hit refreshes LRU recency.
+
+        Divergence is handled HERE, eagerly: if the new turn's tokens split
+        from the stored stream before its end, the entry is truncated to
+        the common prefix — the tail belongs to a history this conversation
+        no longer has, so it could only ever serve stale KV."""
+        entry = self._entries.get(conversation_id)
+        if entry is None or not prompt_ids:
+            return None, 0
+        page = self.page_size
+        prompt = np.asarray(prompt_ids, np.int32)
+        n = min(entry.n_tokens, len(prompt))
+        neq = np.nonzero(entry.token_ids[:n] != prompt[:n])[0]
+        common = int(neq[0]) if neq.size else n
+        if common < entry.n_tokens:
+            self._truncate(entry, (common // page) * page)
+            if entry.n_tokens == 0:
+                return None, 0
+        cap = ((len(prompt) - 1) // page) * page
+        matched = min((common // page) * page, cap)
+        if matched <= 0:
+            return None, 0
+        self._entries.move_to_end(conversation_id)
+        return entry, matched
+
+    def _truncate(self, entry: SessionEntry, n_tokens: int) -> None:
+        """Cut an entry down to a page-aligned token count (divergence).
+        An entry truncated to nothing is dropped entirely."""
+        assert n_tokens % self.page_size == 0 and n_tokens <= entry.n_tokens
+        METRICS.inc("finchat_session_cache_truncations_total")
+        before = entry.nbytes
+        entry.token_ids = entry.token_ids[:n_tokens]
+        if n_tokens <= entry.prefix_len:
+            # the divergence falls inside the shared head: keep only the
+            # matched whole head pages (still referenced, still read-only)
+            entry.prefix_len = n_tokens
+            entry.prefix_pages = entry.prefix_pages[: n_tokens // self.page_size]
+            entry.snap = None
+        else:
+            entry.snap = _slice_snap(
+                entry.snap, (n_tokens - entry.prefix_len) // self.page_size
+            )
+        self._resident_bytes += entry.nbytes - before
+        if entry.n_tokens == 0:
+            del self._entries[entry.conversation_id]
+            self._drop(entry)
+        self._publish_gauges()

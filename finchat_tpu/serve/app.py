@@ -17,6 +17,11 @@ Behavior parity with the reference ``main.py``:
   (status/retrieval_complete/response_chunk/complete), the "richer consumer"
   SURVEY §2.4 calls for.
 - ``GET /metrics`` — Prometheus text (new; SURVEY §5.5).
+- Conversation plumbing (new): every chat path assembles its inputs through
+  ``_conversation_inputs``, which also threads ``conversation_id`` into the
+  agent → generator → scheduler chain as the session-KV-cache key
+  (engine/session_cache.py), so a conversation's next turn resumes the KV
+  its previous turn already computed.
 - Transaction ingestion (new; the reference's upsert pipeline lives outside
   its repo, feeding Qdrant out-of-band — qdrant_tool.py:24-37): both
   ``POST /transactions`` and the ``transaction_upsert`` Kafka topic embed
@@ -383,6 +388,35 @@ class App:
         except Exception as e:
             logger.error("failed to persist vector index: %s", e)
 
+    # --- conversation plumbing ------------------------------------------
+    @staticmethod
+    def _payload_error(payload: dict) -> Response | None:
+        """Shared HTTP validation for the chat endpoints."""
+        missing = [k for k in ("conversation_id", "message", "user_id") if k not in payload]
+        if missing:
+            return Response.json({"detail": f"missing fields: {missing}"}, status=400)
+        return None
+
+    async def _conversation_inputs(
+        self, payload: dict, *, payload_user_id: bool = True
+    ) -> tuple[str, str, str, list]:
+        """THE one place a request's conversation state is assembled —
+        every chat path (REST, SSE, Kafka) goes through here, so the
+        ``conversation_id`` that keys the engine's session KV cache and the
+        context/history fetch can never drift apart. Returns
+        ``(conversation_id, user_id, user_context, chat_history)``. The
+        HTTP paths take ``user_id`` from the validated payload; the Kafka
+        path passes ``payload_user_id=False`` to keep the STORED user id
+        authoritative (reference main.py:64-70 — a spoofed message field
+        must not re-key whose transactions are retrieved)."""
+        conversation_id = payload["conversation_id"]
+        user_context, stored_user_id = await self.store.get_context(conversation_id)
+        chat_history = await self.store.get_history(conversation_id)
+        user_id = stored_user_id
+        if payload_user_id and "user_id" in payload:
+            user_id = payload["user_id"]
+        return conversation_id, user_id, user_context, chat_history
+
     # --- HTTP handlers --------------------------------------------------
     async def health(self, request: Request) -> Response:
         return Response.json({"status": "healthy"})
@@ -394,12 +428,16 @@ class App:
         """Batch REST path (the reference's commented POST /process_message,
         main.py:44-49): runs the compiled agent graph."""
         payload = request.json()
-        missing = [k for k in ("conversation_id", "message", "user_id") if k not in payload]
-        if missing:
-            return Response.json({"detail": f"missing fields: {missing}"}, status=400)
-        user_context, _ = await self.store.get_context(payload["conversation_id"])
-        chat_history = await self.store.get_history(payload["conversation_id"])
-        result = await self.agent.query(payload["message"], payload["user_id"], user_context, chat_history)
+        err = self._payload_error(payload)
+        if err is not None:
+            return err
+        conversation_id, user_id, user_context, chat_history = (
+            await self._conversation_inputs(payload)
+        )
+        result = await self.agent.query(
+            payload["message"], user_id, user_context, chat_history,
+            conversation_id=conversation_id,
+        )
         body = {
             "response": result["response"],
             "retrieved_transactions_count": result["retrieved_transactions_count"],
@@ -411,15 +449,17 @@ class App:
     async def chat_stream(self, request: Request) -> Response | StreamingResponse:
         """SSE stream of the full internal event protocol."""
         payload = request.json()
-        missing = [k for k in ("conversation_id", "message", "user_id") if k not in payload]
-        if missing:
-            return Response.json({"detail": f"missing fields: {missing}"}, status=400)
-        user_context, _ = await self.store.get_context(payload["conversation_id"])
-        chat_history = await self.store.get_history(payload["conversation_id"])
+        err = self._payload_error(payload)
+        if err is not None:
+            return err
+        conversation_id, user_id, user_context, chat_history = (
+            await self._conversation_inputs(payload)
+        )
 
         async def events():
             updates = self.agent.stream_with_status(
-                payload["message"], payload["user_id"], user_context, chat_history
+                payload["message"], user_id, user_context, chat_history,
+                conversation_id=conversation_id,
             )
             # decode_loop bursts re-pace through the SAME per-chunk emit —
             # clients see a smooth token cadence, not K-frame stutters
@@ -478,8 +518,9 @@ class App:
         logger.info("Received message from Kafka: |%s| %s", conversation_id, msg)
 
         try:
-            context, user_id = await self.store.get_context(conversation_id)
-            chat_history = await self.store.get_history(conversation_id)
+            conversation_id, user_id, context, chat_history = (
+                await self._conversation_inputs(message_value, payload_user_id=False)
+            )
         except Exception as e:
             logger.error("Error retrieving context or history for conversation %s: %s", conversation_id, e)
             return
@@ -500,7 +541,9 @@ class App:
                 logger.debug("Processed chunk: %s", text)
 
         try:
-            async for update in self.agent.stream_with_status(msg, user_id, context, chat_history):
+            async for update in self.agent.stream_with_status(
+                msg, user_id, context, chat_history, conversation_id=conversation_id
+            ):
                 if update["type"] == "response_chunk":
                     chunk_text = update["content"]
                     full_message += chunk_text

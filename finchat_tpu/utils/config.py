@@ -183,6 +183,16 @@ class EngineConfig:
     # register_prefix) — the dominant TTFT lever for the RAG workload,
     # whose every prompt repeats the same 1-4.5k-token system prefix
     prefix_cache: bool = True
+    # session KV cache (engine/session_cache.py): host-RAM tier keyed by
+    # conversation_id — a retiring sequence's KV pages snapshot device→host
+    # and the conversation's next turn resumes from the longest matching
+    # page-whole prefix instead of re-prefilling the whole history, so
+    # turn-N TTFT stops growing with history length. Composes with the
+    # shared-prefix cache (cached heads referenced, never copied).
+    session_cache: bool = True
+    # host-RAM byte budget for session KV snapshots (LRU-evicted beyond
+    # it); 0 disables the tier even when session_cache is true
+    session_cache_bytes: int = 256 << 20
     # int8 paged-KV cache (kv_cache.py): halves decode-side KV HBM traffic
     # and cache footprint via per-token-per-head scales; "" = model dtype.
     # Composes with a mesh: scales shard over their head row dim when
@@ -314,6 +324,10 @@ def load_config(
     cfg.engine.sp_mode = _env("FINCHAT_SP_MODE", cfg.engine.sp_mode)
     cfg.engine.kv_quant = _env("FINCHAT_KV_QUANT", cfg.engine.kv_quant)
     cfg.engine.prefix_cache = _env_bool("FINCHAT_PREFIX_CACHE", cfg.engine.prefix_cache)
+    cfg.engine.session_cache = _env_bool("FINCHAT_SESSION_CACHE", cfg.engine.session_cache)
+    cfg.engine.session_cache_bytes = _env_int(
+        "FINCHAT_SESSION_CACHE_BYTES", cfg.engine.session_cache_bytes
+    )
     cfg.serve.port = _env_int("FINCHAT_PORT", cfg.serve.port)
 
     # --- optional JSON config file ---

@@ -11,6 +11,17 @@ Decode-loop family (scheduler decode_loop mode, engine decode_loop_step):
 free-running past finished slots — the fixed-shape block's overhead), and
 ``finchat_decode_loop_demoted_slots`` (gauge — slots currently advancing
 via single-step because they need per-token host control).
+
+Session-KV-cache family (engine/session_cache.py, scheduler offload/resume):
+``finchat_session_cache_hits_total`` / ``_misses_total`` (admission matches
+for conversation-keyed submissions), ``finchat_session_cache_resident_bytes``
+and ``finchat_session_cache_entries`` (gauges — host-RAM tier occupancy),
+``finchat_session_cache_restored_tokens_total`` (prefill tokens skipped by
+resume), ``finchat_session_cache_offloaded_pages_total``,
+``finchat_session_cache_evictions_total`` (LRU under the byte budget),
+``finchat_session_cache_truncations_total`` (divergent-history cuts), and
+the ``finchat_session_offload_seconds`` / ``finchat_session_restore_seconds``
+histograms (D2H snapshot / H2D resume latency).
 """
 
 from __future__ import annotations

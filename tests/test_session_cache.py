@@ -1,0 +1,366 @@
+"""Session KV cache (engine/session_cache.py): cross-turn prefix resume.
+
+Contracts pinned here:
+- golden equality: a resumed turn streams byte-identical greedy tokens to a
+  cold run of the same prompt (the restored KV is the KV the turn would
+  have prefilled itself), and resume skips the matched tokens' prefill;
+- divergence truncation: an edited history matches only up to the split
+  point and the stored tail is cut — stale KV is never served;
+- allocator invariants under offload: offloaded-then-freed pages cannot be
+  double-freed, a failed restore returns its allocation cleanly and the
+  stream falls back to a cold start, ownership invariants hold through
+  churn;
+- LRU eviction under the host-RAM byte budget;
+- composition with the shared-prefix cache: the constant head's pages are
+  referenced (refcounted), never copied, and survive retirement while a
+  session entry points at them.
+"""
+
+import asyncio
+
+import jax
+import numpy as np
+import pytest
+
+from finchat_tpu.engine.engine import InferenceEngine
+from finchat_tpu.engine.kv_cache import PageAllocationError
+from finchat_tpu.engine.sampler import SamplingParams
+from finchat_tpu.engine.scheduler import ContinuousBatchingScheduler
+from finchat_tpu.models.llama import PRESETS, init_params
+from finchat_tpu.models.tokenizer import ByteTokenizer
+from finchat_tpu.utils.config import EngineConfig
+from finchat_tpu.utils.metrics import METRICS
+
+CONFIG = PRESETS["tiny"]
+PAGE = 8
+
+
+def _make_scheduler(max_seqs=4, num_pages=128, session_cache_bytes=64 << 20):
+    tok = ByteTokenizer()
+    cfg = EngineConfig(
+        max_seqs=max_seqs, page_size=PAGE, num_pages=num_pages, max_seq_len=128,
+        prefill_chunk=16, session_cache=session_cache_bytes > 0,
+        session_cache_bytes=session_cache_bytes,
+    )
+    params = init_params(CONFIG, jax.random.key(0))
+    engine = InferenceEngine(CONFIG, params, cfg)
+    return tok, ContinuousBatchingScheduler(engine, eos_id=tok.eos_id)
+
+
+HEAD = "system: you are a terse financial assistant, answer briefly."
+
+
+async def _collect(scheduler, seq_id, prompt_ids, n_new, conversation_id=None):
+    handle = await scheduler.submit(
+        seq_id, prompt_ids, SamplingParams(temperature=0.0, max_new_tokens=n_new),
+        conversation_id=conversation_id,
+    )
+    tokens = []
+    while True:
+        event = await asyncio.wait_for(handle.events.get(), timeout=120)
+        if event["type"] == "token":
+            tokens.append(event["token_id"])
+        elif event["type"] == "done":
+            return handle, tokens
+        else:
+            raise AssertionError(event)
+
+
+def _run_turns(scheduler, turns, conversation_id, n_new=8):
+    """Run a list of prompts as sequential turns of one conversation;
+    returns the per-turn token lists."""
+
+    async def run():
+        await scheduler.start()
+        try:
+            out = []
+            for i, prompt in enumerate(turns):
+                _, tokens = await _collect(
+                    scheduler, f"{conversation_id}-t{i}", prompt, n_new,
+                    conversation_id=conversation_id,
+                )
+                out.append(tokens)
+            return out
+        finally:
+            await scheduler.stop()
+
+    return asyncio.run(run())
+
+
+def test_turn_resume_is_golden_and_skips_prefill():
+    tok = ByteTokenizer()
+    p1 = tok.encode(HEAD + " q1: how much did I spend?", add_bos=True)
+    n_new = 8
+
+    _, warm = _make_scheduler()
+    hits0 = METRICS.get("finchat_session_cache_hits_total")
+    restored0 = METRICS.get("finchat_session_cache_restored_tokens_total")
+
+    async def run_warm():
+        await warm.start()
+        try:
+            _, t1 = await _collect(warm, "t1", p1, n_new, conversation_id="c1")
+            assert len(warm.session_cache) == 1  # retirement offloaded
+            p2 = p1 + t1 + tok.encode(" q2: and last week?", add_bos=False)
+            h2, t2 = await _collect(warm, "t2", p2, n_new, conversation_id="c1")
+            return t1, p2, t2, h2
+        finally:
+            await warm.stop()
+
+    t1, p2, t2, h2 = asyncio.run(run_warm())
+    assert METRICS.get("finchat_session_cache_hits_total") == hits0 + 1
+    matched = METRICS.get("finchat_session_cache_restored_tokens_total") - restored0
+    # the whole first turn (prompt + response minus the uncached last token)
+    # is page-floored and resumed
+    assert matched == ((len(p1) + len(t1) - 1) // PAGE) * PAGE > 0
+    warm.allocator.check_invariants()
+    assert warm.allocator.used_count == 0  # only host copies remain
+
+    # cold: fresh scheduler, session cache disabled, same turn-2 prompt
+    _, cold = _make_scheduler(session_cache_bytes=0)
+    assert cold.session_cache is None
+
+    async def run_cold():
+        await cold.start()
+        try:
+            _, t = await _collect(cold, "t2", p2, n_new, conversation_id="c1")
+            return t
+        finally:
+            await cold.stop()
+
+    assert asyncio.run(run_cold()) == t2  # golden equality
+
+
+def test_divergent_history_truncates_to_matched_prefix():
+    tok = ByteTokenizer()
+    p1 = tok.encode(HEAD + " q1: list my biggest purchases please", add_bos=True)
+    _, scheduler = _make_scheduler()
+
+    trunc0 = METRICS.get("finchat_session_cache_truncations_total")
+    restored0 = METRICS.get("finchat_session_cache_restored_tokens_total")
+
+    keep = (len(p1) // 2 // PAGE) * PAGE  # divergence point, page-aligned
+    p2 = p1[:keep] + tok.encode("completely different history tail now", add_bos=False)
+
+    t2_warm = _run_turns(scheduler, [p1, p2], "c-div")[1]
+    entry = scheduler.session_cache.get("c-div")
+    assert METRICS.get("finchat_session_cache_truncations_total") == trunc0 + 1
+    # resume restored exactly the shared page-whole prefix, nothing stale
+    assert METRICS.get("finchat_session_cache_restored_tokens_total") - restored0 == keep
+    # the re-offloaded turn-2 entry covers turn 2's stream, not the old tail
+    assert entry is not None and list(entry.token_ids[:keep]) == p2[:keep]
+
+    _, cold = _make_scheduler(session_cache_bytes=0)
+    t2_cold = _run_turns(cold, [p2], "c-div")[0]
+    assert t2_warm == t2_cold  # truncation served no stale KV
+
+
+def test_offloaded_then_freed_pages_cannot_be_double_freed():
+    tok = ByteTokenizer()
+    p1 = tok.encode(HEAD + " q: status?", add_bos=True)
+    _, scheduler = _make_scheduler()
+
+    async def run():
+        await scheduler.start()
+        try:
+            h, _ = await _collect(scheduler, "s", p1, 8, conversation_id="c")
+            return h
+        finally:
+            await scheduler.stop()
+
+    handle = asyncio.run(run())
+    scheduler.allocator.check_invariants()
+    assert len(scheduler.session_cache) == 1
+    assert handle.page_list  # pages were recorded at admission...
+    with pytest.raises(PageAllocationError):  # ...and freed exactly once
+        scheduler.allocator.free(handle.seq_id, handle.page_list)
+    # the host snapshot survives reallocation of those device pages
+    entry = scheduler.session_cache.get("c")
+    snap_k = entry.snap[0].copy()
+    scheduler.allocator.allocate("other", len(handle.page_list))
+    assert np.array_equal(entry.snap[0], snap_k)
+
+
+def test_restore_failure_frees_cleanly_and_falls_back_cold():
+    tok = ByteTokenizer()
+    p1 = tok.encode(HEAD + " q1: how much did I spend?", add_bos=True)
+    n_new = 8
+
+    _, cold = _make_scheduler(session_cache_bytes=0)
+    _, scheduler = _make_scheduler()
+    boom = {"raised": 0}
+    real_restore = scheduler.engine.restore_pages
+
+    def failing_restore(page_ids, host):
+        boom["raised"] += 1
+        raise RuntimeError("injected restore failure")
+
+    async def run():
+        await scheduler.start()
+        try:
+            _, t1 = await _collect(scheduler, "t1", p1, n_new, conversation_id="c")
+            p2 = p1 + t1 + tok.encode(" q2?", add_bos=False)
+            scheduler.engine.restore_pages = failing_restore
+            try:
+                _, t2 = await _collect(scheduler, "t2", p2, n_new, conversation_id="c")
+            finally:
+                scheduler.engine.restore_pages = real_restore
+            return p2, t2
+        finally:
+            await scheduler.stop()
+
+    p2, t2 = asyncio.run(run())
+    assert boom["raised"] == 1  # the resume path was attempted
+    scheduler.allocator.check_invariants()
+    assert scheduler.allocator.used_count == 0  # nothing leaked
+    t2_cold = _run_turns(cold, [p2], "c")[0]
+    assert t2 == t2_cold  # the stream survived as a cold start
+
+
+def test_lru_eviction_under_byte_budget():
+    tok = ByteTokenizer()
+    _, probe = _make_scheduler()
+    p = tok.encode(HEAD + " q1: how much did I spend overall?", add_bos=True)
+    _run_turns(probe, [p], "c0")
+    one_entry = probe.session_cache.get("c0").nbytes
+    assert one_entry > 0
+
+    # budget for two entries; the third insert evicts the LRU conversation
+    _, scheduler = _make_scheduler(session_cache_bytes=2 * one_entry)
+    ev0 = METRICS.get("finchat_session_cache_evictions_total")
+    for i in range(3):
+        _run_turns(scheduler, [p], f"c{i}")
+    cache = scheduler.session_cache
+    assert METRICS.get("finchat_session_cache_evictions_total") == ev0 + 1
+    assert cache.get("c0") is None  # least recently used went first
+    assert cache.get("c1") is not None and cache.get("c2") is not None
+    assert cache.resident_bytes <= cache.budget_bytes
+    assert METRICS.get("finchat_session_cache_resident_bytes") == cache.resident_bytes
+
+
+def test_composes_with_shared_prefix_head():
+    tok = ByteTokenizer()
+    _, scheduler = _make_scheduler()
+    head_ids = tok.encode(HEAD, add_bos=True)
+    shared = scheduler.register_prefix(head_ids)
+    assert shared > 0
+    prefix_pages = scheduler.allocator.used_count
+
+    p1 = head_ids + tok.encode(" q1: what changed?", add_bos=False)
+    t1 = _run_turns(scheduler, [p1], "c")[0]
+    entry = scheduler.session_cache.get("c")
+    # the head rode the shared-prefix entry: referenced, never copied
+    assert entry.prefix_len == shared
+    assert entry.prefix_entry is scheduler._prefixes[0]
+    assert entry.prefix_entry.refs == 1  # held by the session entry
+    own_pages = (((len(p1) + len(t1) - 1) // PAGE) * PAGE - shared) // PAGE
+    assert entry.snap[0].shape[1] == own_pages  # host copy excludes the head
+    assert scheduler.allocator.used_count == prefix_pages  # device: head only
+
+    # a resumed turn references the head pages while the head is LIVE
+    p2 = p1 + t1 + tok.encode(" q2: and now?", add_bos=False)
+    hits0 = METRICS.get("finchat_session_cache_hits_total")
+    t2_warm = _run_turns(scheduler, [p2], "c")[0]
+    assert METRICS.get("finchat_session_cache_hits_total") == hits0 + 1
+    assert scheduler.allocator.used_count == prefix_pages
+
+    _, cold = _make_scheduler(session_cache_bytes=0)
+    assert _run_turns(cold, [p2], "c")[0] == t2_warm  # golden through it all
+
+    # retirement (date rollover) purges entries referencing the retired
+    # head — post-rollover prompts diverge inside the head, so keeping the
+    # entry would only pin the retired head's device pages indefinitely
+    scheduler.retire_prefixes()
+    assert len(scheduler.session_cache) == 0
+    scheduler.allocator.check_invariants()
+    assert scheduler.allocator.used_count == 0  # head pages freed at once
+    assert scheduler._prefixes == []
+
+
+def test_incremental_offload_reuses_prior_snapshot():
+    """Turn N's retirement must D2H-copy only the pages written THIS turn;
+    pages restored at admission (and never rewritten) reuse the previous
+    entry's host bytes — otherwise per-turn offload cost grows linearly
+    with history, the exact tax the cache exists to remove."""
+    tok = ByteTokenizer()
+    _, scheduler = _make_scheduler()
+    p1 = tok.encode(HEAD + " q1: spending?", add_bos=True)
+    n_new = 8
+
+    async def run():
+        await scheduler.start()
+        try:
+            _, t1 = await _collect(scheduler, "t1", p1, n_new, conversation_id="c")
+            off1 = METRICS.get("finchat_session_cache_offloaded_pages_total")
+            p2 = p1 + t1 + tok.encode(" q2: more?", add_bos=False)
+            _, t2 = await _collect(scheduler, "t2", p2, n_new, conversation_id="c")
+            off2 = METRICS.get("finchat_session_cache_offloaded_pages_total")
+            return p2, t2, int(off2 - off1)
+        finally:
+            await scheduler.stop()
+
+    p2, t2, delta = asyncio.run(run())
+    matched2 = ((len(p1) + n_new - 1) // PAGE) * PAGE  # resumed at turn 2
+    n_tok2 = ((len(p2) + n_new - 1) // PAGE) * PAGE  # turn 2's KV coverage
+    assert delta == (n_tok2 - matched2) // PAGE  # only the new pages copied
+    assert delta < n_tok2 // PAGE  # strictly less than a full re-copy
+    # and the spliced snapshot still resumes byte-identically (turn 3)
+    p3 = p2 + t2 + tok.encode(" q3: final?", add_bos=False)
+    t3_warm = _run_turns(scheduler, [p3], "c")[0]
+    _, cold = _make_scheduler(session_cache_bytes=0)
+    assert _run_turns(cold, [p3], "c")[0] == t3_warm
+
+
+def test_cancel_and_error_do_not_offload():
+    tok = ByteTokenizer()
+    _, scheduler = _make_scheduler()
+    p = tok.encode(HEAD + " q: cancel me", add_bos=True)
+
+    async def run():
+        await scheduler.start()
+        try:
+            handle = await scheduler.submit(
+                "s", p, SamplingParams(temperature=0.0, max_new_tokens=48),
+                conversation_id="c",
+            )
+            await asyncio.wait_for(handle.events.get(), timeout=120)  # first token
+            scheduler.cancel(handle)
+            while True:
+                event = await asyncio.wait_for(handle.events.get(), timeout=120)
+                if event["type"] == "done":
+                    return event
+        finally:
+            await scheduler.stop()
+
+    event = asyncio.run(run())
+    assert event["reason"] == "cancelled"
+    assert len(scheduler.session_cache) == 0  # no partial-stream snapshots
+    scheduler.allocator.check_invariants()
+    assert scheduler.allocator.used_count == 0
+
+
+def test_top_k_clamp_warning_logged_once_per_value(caplog):
+    import logging
+
+    tok = ByteTokenizer()
+    _, scheduler = _make_scheduler()
+    p = tok.encode("hello", add_bos=True)
+    clamped0 = METRICS.get("finchat_top_k_clamped_total")
+
+    async def run():
+        with caplog.at_level(logging.WARNING, logger="finchat_tpu.engine.scheduler"):
+            for i in range(4):  # same oversized top_k, four requests
+                await scheduler.submit(
+                    f"s{i}", p,
+                    SamplingParams(temperature=0.7, top_k=10_000, max_new_tokens=4),
+                )
+            await scheduler.submit(  # a DISTINCT clamp value logs again
+                "s-other", p,
+                SamplingParams(temperature=0.7, top_k=20_000, max_new_tokens=4),
+            )
+
+    asyncio.run(run())
+    warnings = [r for r in caplog.records if "sampler candidate cap" in r.message]
+    assert len(warnings) == 2  # once per distinct top_k, not per request
+    # the clamp itself still applied every time
+    assert METRICS.get("finchat_top_k_clamped_total") == clamped0 + 5
