@@ -89,6 +89,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "output identity check")
     p.add_argument("--session-turns", type=int, default=4,
                    help="conversation turns for --session-sweep")
+    p.add_argument("--retrieval-sweep", action="store_true",
+                   help="CPU-runnable sweep of the batched retrieval plane "
+                        "(embed/batcher.py + embed/index.py + agent overlap): "
+                        "embed dispatches/query and batch occupancy over "
+                        "concurrency x wait-window, plus end-to-end TTFT "
+                        "through the real agent+scheduler with "
+                        "retrieval_overlap off vs on (greedy outputs "
+                        "asserted byte-identical)")
+    p.add_argument("--retrieval-concurrency", default="1,2,4,8",
+                   help="comma-separated concurrent-request counts for "
+                        "--retrieval-sweep")
+    p.add_argument("--retrieval-windows-ms", default="0,2,5",
+                   help="comma-separated embed wait-windows (ms) for "
+                        "--retrieval-sweep")
+    p.add_argument("--retrieval-smoke", action="store_true",
+                   help="tiny --retrieval-sweep variant for CI: fewer "
+                        "rounds/repeats, coalescing+identity checks only")
     p.add_argument("--tpu-timeout", type=float, default=180.0,
                    help="seconds allowed for TPU backend INIT before the "
                         "child is declared hung (measurement gets "
@@ -140,7 +157,13 @@ def run_worker(args: argparse.Namespace) -> int:
     faulthandler.dump_traceback_later(max(60.0, args.measure_budget - 10.0), exit=True)
 
     work = resolve_workload(args, "tpu" if platform == "tpu" else "cpu")
-    if args.session_sweep:
+    if args.retrieval_sweep:
+        result = measure_retrieval_sweep(
+            concurrency=tuple(int(c) for c in args.retrieval_concurrency.split(",")),
+            windows_ms=tuple(float(w) for w in args.retrieval_windows_ms.split(",")),
+            smoke=args.retrieval_smoke,
+        )
+    elif args.session_sweep:
         if args.page_size is None:
             # page granularity is the resume resolution: the headline 128
             # would swallow a whole short turn per page at sweep scale
@@ -719,6 +742,213 @@ def measure_session_sweep(
     }
 
 
+def measure_retrieval_sweep(
+    concurrency: tuple = (1, 2, 4, 8), windows_ms: tuple = (0.0, 2.0, 5.0),
+    smoke: bool = False,
+) -> dict:
+    """Benchmark the batched retrieval plane (ISSUE 3), CPU-runnable.
+
+    Part 1 — microbatcher: for each (concurrent requests, wait window),
+    fire the requests together through the EmbedMicrobatcher and report
+    embed DISPATCHES PER QUERY (the coalescing figure of merit: 1.0 means
+    every request paid its own device dispatch, 1/c means perfect
+    coalescing) and mean batch occupancy, both read from the metrics the
+    serving plane exports.
+
+    Part 2 — retrieval/prefill overlap: the REAL agent + scheduler +
+    retriever stack (stub tool decision forcing retrieval; mini decoder),
+    one warm run then timed runs of the streaming path with
+    ``retrieval_overlap`` off vs on. Reports median TTFT each way and
+    asserts the greedy streamed text is byte-identical — the overlap must
+    be a pure latency optimization.
+    """
+    import asyncio
+
+    import jax
+    import numpy as np
+
+    from finchat_tpu.agent.graph import LLMAgent
+    from finchat_tpu.embed.batcher import EmbedMicrobatcher
+    from finchat_tpu.embed.encoder import EMBED_PRESETS, EmbeddingEncoder, init_bert_params
+    from finchat_tpu.embed.index import DeviceVectorIndex
+    from finchat_tpu.engine.engine import InferenceEngine
+    from finchat_tpu.engine.generator import EngineGenerator, StubGenerator
+    from finchat_tpu.engine.kv_cache import pages_needed
+    from finchat_tpu.engine.sampler import SamplingParams
+    from finchat_tpu.engine.scheduler import ContinuousBatchingScheduler
+    from finchat_tpu.io.schemas import ChatMessage
+    from finchat_tpu.models.llama import PRESETS, init_params
+    from finchat_tpu.models.tokenizer import ByteTokenizer, get_tokenizer
+    from finchat_tpu.tools.retrieval import TransactionRetriever
+    from finchat_tpu.utils.config import EngineConfig
+    from finchat_tpu.utils.metrics import METRICS
+
+    embed_cfg = EMBED_PRESETS["bge-tiny"]
+    encoder = EmbeddingEncoder(
+        embed_cfg, init_bert_params(embed_cfg, jax.random.key(0)), ByteTokenizer()
+    )
+    encoder.embed_batch(["warm the encode_batch variants"])  # compile
+
+    rounds = 2 if smoke else 6
+    queries = [f"spending on category {i} last month" for i in range(64)]
+
+    async def run_cell(conc: int, window_ms: float) -> dict:
+        batcher = EmbedMicrobatcher(encoder, window_ms=window_ms, max_batch=32)
+        d0 = METRICS.get("finchat_embed_batch_dispatches_total")
+        r0 = METRICS.get("finchat_embed_requests_total")
+        t0 = time.perf_counter()
+        for r in range(rounds):
+            await asyncio.gather(
+                *[batcher.embed_one(queries[(r * conc + i) % len(queries)])
+                  for i in range(conc)]
+            )
+        elapsed = time.perf_counter() - t0
+        await batcher.close()
+        dispatches = METRICS.get("finchat_embed_batch_dispatches_total") - d0
+        requests = METRICS.get("finchat_embed_requests_total") - r0
+        return {
+            "concurrency": conc,
+            "window_ms": window_ms,
+            "dispatches_per_query": round(dispatches / max(requests, 1), 3),
+            "mean_batch_occupancy": round(requests / max(dispatches, 1), 2),
+            "mean_embed_latency_ms": round(1000 * elapsed / rounds, 2),
+        }
+
+    micro = [
+        asyncio.run(run_cell(c, w)) for w in windows_ms for c in concurrency
+    ]
+    for cell in micro:
+        print(f"[bench] embed microbatch c={cell['concurrency']} "
+              f"w={cell['window_ms']}ms: {cell['dispatches_per_query']} "
+              f"dispatches/query, occupancy {cell['mean_batch_occupancy']}",
+              file=sys.stderr, flush=True)
+    coalescing_ok = all(
+        cell["dispatches_per_query"] < 1.0
+        for cell in micro
+        if cell["concurrency"] >= 4 and cell["window_ms"] > 0
+    )
+
+    # ---- part 2: retrieval/prefill overlap TTFT through the real stack --
+    # Sized so the full prompt (system + context + history + retrieved
+    # block + query, byte tokenizer) FITS the engine budget: history
+    # windowing would change the static prefix after the hold was taken
+    # and every overlap run would fall back serially (testing nothing).
+    config = PRESETS["mini"]
+    page_size = 32
+    max_seq_len = 1024
+    pps = pages_needed(max_seq_len, page_size)
+    n_rows = 64 if smoke else 512
+    repeats = 3 if smoke else 7
+    history_turns = 4 if smoke else 8
+    max_new = 8
+
+    now = time.time()
+    rng = np.random.default_rng(0)
+    index = DeviceVectorIndex(dim=embed_cfg.dim)
+    seed_retriever = TransactionRetriever(encoder, index, now=lambda: now)
+    seed_retriever.upsert_transactions(
+        "alice",
+        [f"PURCHASE #{i} ${rng.integers(1, 500)}.{rng.integers(0, 99):02d} "
+         f"merchant-{i % 13}" for i in range(n_rows)],
+        dates=[now - 3600.0 * i for i in range(n_rows)],
+    )
+    history = [
+        ChatMessage(
+            sender="UserMessage" if i % 2 == 0 else "AIMessage",
+            message=f"turn {i}: thinking about budget and savings",
+        )
+        for i in range(history_turns)
+    ]
+
+    async def run_stream(agent) -> tuple[float, str]:
+        t0 = time.perf_counter()
+        ttft, text = None, []
+        async for ev in agent.stream_with_status(
+            "what did I spend at merchant-3?", "alice", "Savings goal: $10k.",
+            history, conversation_id=None,
+        ):
+            if ev["type"] == "response_chunk":
+                if ttft is None:
+                    ttft = time.perf_counter() - t0
+                text.append(ev["content"])
+        return ttft, "".join(text)
+
+    async def run_modes():
+        # ONE engine + scheduler serves both modes: identical compiled
+        # variants and warmed state, so the off/on comparison measures the
+        # overlap, not compile-cache luck
+        ecfg = EngineConfig(
+            max_seqs=4, page_size=page_size, num_pages=4 * pps + 8,
+            max_seq_len=max_seq_len, prefill_chunk=64, session_cache=False,
+        )
+        engine = InferenceEngine(config, init_params(config, jax.random.key(0)), ecfg)
+        scheduler = ContinuousBatchingScheduler(engine, eos_id=-1)
+        await scheduler.start()
+        batcher = EmbedMicrobatcher(encoder, window_ms=2.0, max_batch=32)
+        try:
+            retriever = TransactionRetriever(
+                encoder, index, now=lambda: now, batcher=batcher
+            )
+            generator = EngineGenerator(scheduler, get_tokenizer())
+            results = {}
+            for overlap in (False, True):
+                agent = LLMAgent(
+                    StubGenerator(
+                        default='retrieve_transactions({"search_query": '
+                                '"spending at merchant-3", "num_transactions": 6})'
+                    ),
+                    generator, retriever, "You are Penny, a financial assistant.",
+                    "Decide retrieval.",
+                    response_sampling=SamplingParams(
+                        temperature=0.0, max_new_tokens=max_new
+                    ),
+                    today=lambda: "2026-08-03",
+                    retrieval_overlap=overlap,
+                )
+                ttfts, text = [], None
+                for _ in range(repeats + 1):  # first run warms compiles
+                    ttft, out = await run_stream(agent)
+                    assert text is None or text == out, "nondeterministic greedy run"
+                    text = out
+                    ttfts.append(ttft)
+                results[overlap] = (ttfts[1:], text)
+            return results
+        finally:
+            await batcher.close()
+            await scheduler.stop()
+
+    g0 = METRICS.get("finchat_partial_grafts_total")
+    results = asyncio.run(run_modes())
+    off_ttfts, off_text = results[False]
+    on_ttfts, on_text = results[True]
+    grafts = int(METRICS.get("finchat_partial_grafts_total") - g0)
+    ttft_off = float(np.median(off_ttfts))
+    ttft_on = float(np.median(on_ttfts))
+    print(f"[bench] retrieval overlap TTFT: off {1000*ttft_off:.1f} ms -> "
+          f"on {1000*ttft_on:.1f} ms (grafts={grafts}, repeats={repeats})",
+          file=sys.stderr, flush=True)
+
+    return {
+        "metric": "retrieval_sweep",
+        "unit": "dispatches/query, ttft ms",
+        "smoke": smoke,
+        "embed_preset": "bge-tiny",
+        "index_rows": n_rows,
+        "history_turns": history_turns,
+        "microbatch": micro,
+        "coalescing_ok": coalescing_ok,
+        "ttft_ms_overlap_off": round(1000 * ttft_off, 1),
+        "ttft_ms_overlap_on": round(1000 * ttft_on, 1),
+        "ttft_off_ms_all": [round(1000 * t, 1) for t in off_ttfts],
+        "ttft_on_ms_all": [round(1000 * t, 1) for t in on_ttfts],
+        "overlap_ttft_improved": ttft_on < ttft_off,
+        "overlap_grafts": grafts,
+        "greedy_outputs_identical": on_text == off_text,
+        "platform": jax.devices()[0].platform,
+        "device": str(jax.devices()[0]),
+    }
+
+
 # --------------------------------------------------------------------------
 # Orchestrator: jax-free; spawns workers, never hangs, always prints JSON.
 # --------------------------------------------------------------------------
@@ -738,6 +968,12 @@ def spawn_worker(args: argparse.Namespace, platform: str, timeout: float) -> dic
                 "--decode-loop-depths", args.decode_loop_depths]
     if args.session_sweep:
         cmd += ["--session-sweep", "--session-turns", str(args.session_turns)]
+    if args.retrieval_sweep:
+        cmd += ["--retrieval-sweep",
+                "--retrieval-concurrency", args.retrieval_concurrency,
+                "--retrieval-windows-ms", args.retrieval_windows_ms]
+        if args.retrieval_smoke:
+            cmd += ["--retrieval-smoke"]
     print(f"[bench] spawning {platform} worker (timeout {timeout:.0f}s)",
           file=sys.stderr, flush=True)
     try:

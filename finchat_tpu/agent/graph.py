@@ -89,6 +89,7 @@ class LLMAgent:
         tool_sampling: SamplingParams | None = None,
         response_sampling: SamplingParams | None = None,
         today: Callable[[], str] = lambda: date.today().isoformat(),
+        retrieval_overlap: bool = True,
     ):
         self.tool_generator = tool_generator
         self.response_generator = response_generator
@@ -105,6 +106,12 @@ class LLMAgent:
         )
         self.response_sampling = response_sampling or SamplingParams(temperature=0.5)
         self.today = today
+        # retrieval/prefill overlap (ISSUE 3): prefill the response
+        # prompt's static prefix (system + context + history) WHILE the
+        # retrieval tool runs, grafting the retrieved block in when it
+        # arrives. Needs a generator exposing the partial-prefill seam
+        # (EngineGenerator); anything else silently uses the serial path.
+        self.retrieval_overlap = retrieval_overlap
         self.graph = self._build_graph()
         logger.info("Agent initialized with state graph")
 
@@ -155,14 +162,35 @@ class LLMAgent:
 
     def _response_prompt_text(self, state: AgentState) -> str:
         def build(s: AgentState) -> str:
-            context = f"{s.user_context}\n"
+            # the retrieved block rides the FINAL user turn, not the system
+            # context: everything upstream of it (system + context +
+            # history) is then static before retrieval returns, which is
+            # what lets the overlap plane prefill it concurrently with the
+            # embed+search (``_response_prefix_text`` is its byte prefix)
+            user_input = s.user_query
             if s.retrieved_transactions:
-                context += "Retrieved Transaction Data:\n" + "\n".join(s.retrieved_transactions)
+                user_input = (
+                    "Retrieved Transaction Data:\n"
+                    + "\n".join(s.retrieved_transactions)
+                    + f"\n\n{s.user_query}"
+                )
             return render_chat(
-                self._response_system(), context, s.chat_history, s.user_query
+                self._response_system(), f"{s.user_context}\n", s.chat_history, user_input
             )
 
         return self._fit_prompt(build, state, self.response_generator, self.response_sampling)
+
+    def _response_prefix_text(self, state: AgentState) -> str:
+        """The static prefix of ``_response_prompt_text``: known before the
+        retrieval tool returns, byte-prefix by construction (same system /
+        context / history feed ``render_chat_prefix``, which ``render_chat``
+        builds from). If ``_fit_prompt`` later windows history away, the
+        prefix stops matching and the overlap plane falls back serially."""
+        from finchat_tpu.models.tokenizer import render_chat_prefix
+
+        return render_chat_prefix(
+            self._response_system(), f"{state.user_context}\n", state.chat_history
+        )
 
     def _fit_prompt(
         self,
@@ -261,10 +289,44 @@ class LLMAgent:
         logger.info("Retrieving transaction data")
         if not state.tool_calls:
             return state
+        import asyncio
+
+        tool_call = state.tool_calls.popleft()
+        tool_args = dict(tool_call.args)
+        tool_args["user_id"] = state.user_id  # server-side injection, never model-chosen
+        if self.retrieval_overlap and hasattr(self.response_generator, "begin_partial"):
+            # overlap: the tool (embed + search + graft assembly) runs as a
+            # task while the response prompt's static prefix submits for
+            # prefill — by the time retrieval returns, the scheduler has
+            # the system+context+history KV in flight or done, and only
+            # the retrieved block + user turn remain to prefill
+            retrieval = asyncio.create_task(self._run_tool(state, tool_call, tool_args))
+            try:
+                try:
+                    state.partial_prefill = await self.response_generator.begin_partial(
+                        self._response_prefix_text(state), self.response_sampling,
+                        conversation_id=self._session_key(state, "resp"),
+                    )
+                except Exception as e:  # overlap is an optimization, never fatal
+                    logger.warning("partial prefill unavailable, serial path: %s", e)
+                    state.partial_prefill = None
+                await retrieval
+            except BaseException:
+                # cancellation (client disconnect, watchdog) must not orphan
+                # the in-flight tool task
+                retrieval.cancel()
+                try:
+                    await retrieval
+                except (asyncio.CancelledError, Exception):
+                    pass
+                raise
+        else:
+            await self._run_tool(state, tool_call, tool_args)
+        return state
+
+    async def _run_tool(self, state: AgentState, tool_call: ToolCall,
+                        tool_args: dict[str, Any]) -> None:
         try:
-            tool_call = state.tool_calls.popleft()
-            tool_args = dict(tool_call.args)
-            tool_args["user_id"] = state.user_id  # server-side injection, never model-chosen
             if tool_call.name == "create_financial_plot" and hasattr(self.retriever, "structured"):
                 rows = await self.retriever.structured(tool_args)
                 state.retrieved_transactions = [r["page_content"] for r in rows]
@@ -290,14 +352,32 @@ class LLMAgent:
         except Exception as e:
             logger.error("Error running tool: %s", e)
             state.retrieved_transactions = [f"Error: {e}"]
-        return state
+
+    def _response_kwargs(self, state: AgentState) -> dict[str, Any]:
+        """Generation kwargs for the response role. ``partial`` is only
+        passed when the overlap path actually took a hold — so generators
+        without the seam (StubGenerator, test doubles) never see it."""
+        kwargs: dict[str, Any] = {"conversation_id": self._session_key(state, "resp")}
+        if state.partial_prefill is not None:
+            kwargs["partial"] = state.partial_prefill
+        return kwargs
+
+    def _release_partial(self, state: AgentState) -> None:
+        """Leak guard: a hold the generator never claimed (generation
+        failed upstream, stream abandoned) must give back its slot and KV
+        pages; a claimed one is the stream's to manage."""
+        if state.partial_prefill is not None and hasattr(
+            self.response_generator, "release_partial"
+        ):
+            self.response_generator.release_partial(state.partial_prefill)
+        state.partial_prefill = None
 
     async def _generate_response_node(self, state: AgentState) -> AgentState:
         """Node 3: generate the final response (non-streaming graph path)."""
         logger.info("Generating final response")
         state.final_response = await self.response_generator.generate(
             self._response_prompt_text(state), self.response_sampling,
-            conversation_id=self._session_key(state, "resp"),
+            **self._response_kwargs(state),
         )
         logger.info("Final response generated")
         return state
@@ -328,7 +408,10 @@ class LLMAgent:
             chat_history=list(chat_history or []),
             tool_calls=deque(),
         )
-        final_state = await self.graph.ainvoke(state)
+        try:
+            final_state = await self.graph.ainvoke(state)
+        finally:
+            self._release_partial(state)
         return {
             "response": final_state.final_response,
             "retrieved_transactions_count": len(final_state.retrieved_transactions),
@@ -358,30 +441,35 @@ class LLMAgent:
             tool_calls=deque(),
         )
 
-        yield {"type": "status", "message": "Analyzing query to determine if transaction data is needed..."}
-        state = await self._decide_retrieval_node(state)
+        try:
+            yield {"type": "status", "message": "Analyzing query to determine if transaction data is needed..."}
+            state = await self._decide_retrieval_node(state)
 
-        if self._should_retrieve(state) == "retrieve":
-            yield {"type": "status", "message": "Retrieving relevant transaction data..."}
-            state = await self._retrieve_data_node(state)
-            yield {
-                "type": "retrieval_complete",
-                "count": len(state.retrieved_transactions),
-                "message": f"Retrieved {len(state.retrieved_transactions)} transactions",
-            }
-            if state.plot_data_uri:
-                yield {"type": "plot", "data_uri": state.plot_data_uri}
-        else:
-            yield {"type": "status", "message": "No transaction data retrieval needed"}
+            if self._should_retrieve(state) == "retrieve":
+                yield {"type": "status", "message": "Retrieving relevant transaction data..."}
+                state = await self._retrieve_data_node(state)
+                yield {
+                    "type": "retrieval_complete",
+                    "count": len(state.retrieved_transactions),
+                    "message": f"Retrieved {len(state.retrieved_transactions)} transactions",
+                }
+                if state.plot_data_uri:
+                    yield {"type": "plot", "data_uri": state.plot_data_uri}
+            else:
+                yield {"type": "status", "message": "No transaction data retrieval needed"}
 
-        yield {"type": "status", "message": "Generating response..."}
+            yield {"type": "status", "message": "Generating response..."}
 
-        async for chunk in self.response_generator.stream(
-            self._response_prompt_text(state), self.response_sampling,
-            conversation_id=self._session_key(state, "resp"),
-        ):
-            if chunk:
-                yield {"type": "response_chunk", "content": chunk}
+            async for chunk in self.response_generator.stream(
+                self._response_prompt_text(state), self.response_sampling,
+                **self._response_kwargs(state),
+            ):
+                if chunk:
+                    yield {"type": "response_chunk", "content": chunk}
+        finally:
+            # a hold the stream never claimed (consumer abandoned the
+            # generator, an upstream error) must not pin its slot/pages
+            self._release_partial(state)
 
         yield {"type": "complete", "message": "Query processing completed"}
         logger.info("Status streaming completed")

@@ -33,3 +33,7 @@ class AgentState:
     retrieved_transactions: list[str] = field(default_factory=list)
     plot_data_uri: str | None = None  # create_financial_plot output
     final_response: str | None = None
+    # retrieval/prefill overlap: the engine's in-flight partial prefill of
+    # the response prompt's static prefix (generator.begin_partial handle),
+    # taken while retrieval runs and grafted at generation time
+    partial_prefill: Any = None

@@ -1,11 +1,14 @@
+from finchat_tpu.embed.batcher import EmbedMicrobatcher
 from finchat_tpu.embed.encoder import BertConfig, EMBED_PRESETS, EmbeddingEncoder, init_bert_params
-from finchat_tpu.embed.index import DeviceVectorIndex, VectorPoint
+from finchat_tpu.embed.index import DeviceVectorIndex, QuerySpec, VectorPoint
 
 __all__ = [
     "BertConfig",
     "EMBED_PRESETS",
+    "EmbedMicrobatcher",
     "EmbeddingEncoder",
     "init_bert_params",
     "DeviceVectorIndex",
+    "QuerySpec",
     "VectorPoint",
 ]

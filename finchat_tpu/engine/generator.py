@@ -88,9 +88,40 @@ class EngineGenerator:
             raise
         return TokenConstraint(vocab)
 
+    # --- retrieval/prefill overlap (ISSUE 3) -----------------------------
+    async def begin_partial(
+        self, prefix_text: str, sampling: SamplingParams,
+        conversation_id: str | None = None,
+    ):
+        """Start prefilling a prompt's static prefix while its tail (the
+        retrieval graft) is still being computed. Returns an opaque handle
+        to pass to ``stream(..., partial=...)``, or None when the prefix
+        can't ride the overlap path (over budget, ring-eligible, grammar
+        use). The final encoded token is dropped — a subword tokenizer can
+        merge across the graft boundary, so the last prefix token is the
+        only one whose identity depends on what follows (the same boundary
+        rule as the shared-prefix head registration, serve/app.py)."""
+        if sampling.grammar:
+            return None  # constrained decodes need per-token host control
+        prefix_ids = self.tokenizer.encode(prefix_text, add_bos=True)[:-1]
+        if not prefix_ids or len(prefix_ids) > self.prompt_budget(sampling):
+            return None
+        return await self.scheduler.submit_partial(
+            f"seq-{next(self._ids)}", prefix_ids, sampling,
+            conversation_id=conversation_id,
+        )
+
+    def release_partial(self, partial) -> None:
+        """Drop an unconsumed partial hold (retrieval errored before
+        generation, or the caller bailed): frees its slot and pages. A
+        hold that was already claimed by ``stream`` is left alone."""
+        if partial is not None and not getattr(partial, "_partial_claimed", False):
+            self.scheduler.cancel(partial)
+
     async def stream(
         self, prompt: str, sampling: SamplingParams,
         conversation_id: str | None = None,
+        partial=None,
     ) -> AsyncIterator[str]:
         prompt_ids = self.tokenizer.encode(prompt, add_bos=True)
         budget = self.prompt_budget(sampling)
@@ -106,12 +137,28 @@ class EngineGenerator:
                 len(prompt_ids), budget, head, tail,
             )
             prompt_ids = prompt_ids[:head] + prompt_ids[-tail:]
-        seq_id = f"seq-{next(self._ids)}"
-        constraint = await self._make_constraint(sampling.grammar) if sampling.grammar else None
-        handle = await self.scheduler.submit(
-            seq_id, prompt_ids, sampling, constraint=constraint,
-            conversation_id=conversation_id,
-        )
+        handle = None
+        if partial is not None:
+            from finchat_tpu.utils.metrics import METRICS, Timer
+
+            # claim BEFORE the extend attempt: whatever happens next, the
+            # hold is this stream's to consume or cancel
+            partial._partial_claimed = True
+            with Timer(METRICS, "finchat_retrieval_graft_seconds"):
+                grafted = self.scheduler.extend_prompt(partial, prompt_ids)
+            if grafted:
+                handle = partial
+            else:
+                # graft point invalidated (windowing changed the prefix,
+                # budget splice, pages unavailable): clean serial fallback
+                self.scheduler.cancel(partial)
+        if handle is None:
+            seq_id = f"seq-{next(self._ids)}"
+            constraint = await self._make_constraint(sampling.grammar) if sampling.grammar else None
+            handle = await self.scheduler.submit(
+                seq_id, prompt_ids, sampling, constraint=constraint,
+                conversation_id=conversation_id,
+            )
         decoder = IncrementalDecoder(self.tokenizer)
         try:
             while True:
@@ -134,10 +181,12 @@ class EngineGenerator:
     async def generate(
         self, prompt: str, sampling: SamplingParams,
         conversation_id: str | None = None,
+        partial=None,
     ) -> str:
         return "".join([
             piece async for piece in self.stream(
-                prompt, sampling, conversation_id=conversation_id
+                prompt, sampling, conversation_id=conversation_id,
+                partial=partial,
             )
         ])
 

@@ -116,6 +116,16 @@ class SequenceHandle:
     # on the segmented seq-sharded prefill path (prefill_pos > 0 there
     # means "mid-ring", NOT "ride the chunked batch")
     ring_path: bool = False
+    # retrieval/prefill overlap (submit_partial): ``prompt_ids`` is only
+    # the prompt's STATIC PREFIX — prefill it, then PARK without
+    # committing a first token until extend_prompt grafts the full
+    # prompt (or the hold goes stale and is reaped)
+    held: bool = False
+    held_deadline: float = 0.0
+    # the hold was extended into a full prompt: the remaining suffix MUST
+    # keep the chunked prefill path (the seq-sharded ring paths assume
+    # they owned the prompt from position 0 / their own segment schedule)
+    grafted: bool = False
     submitted_at: float = field(default_factory=time.perf_counter)
     first_token_at: float | None = None
     finished: bool = False
@@ -319,6 +329,115 @@ class ContinuousBatchingScheduler:
         METRICS.set_gauge("finchat_queue_depth", len(self.pending))
         self._wakeup.set()
         return handle
+
+    # retrieval/prefill overlap (ISSUE 3): how long a parked hold may wait
+    # for its extend_prompt before the scheduler reclaims its slot+pages —
+    # retrieval is ms-scale, so a hold this old means its owner died
+    HOLD_TTL_S = 30.0
+
+    async def submit_partial(
+        self,
+        seq_id: str,
+        prefix_ids: list[int],
+        sampling: SamplingParams,
+        conversation_id: str | None = None,
+    ) -> SequenceHandle | None:
+        """Start prefilling a prompt whose TAIL is not known yet (the
+        retrieval/prefill overlap path): ``prefix_ids`` is the static
+        leading part of the final prompt (system head + context + history
+        — everything upstream of the retrieval graft point). The sequence
+        admits and prefills normally but PARKS when the prefix is done
+        instead of committing a first token; ``extend_prompt`` grafts the
+        full prompt in when retrieval returns and prefill continues from
+        the parked position. Returns None when the prefix can't ride this
+        path (empty, over budget, or seq-sharded-ring eligible — the ring
+        prefill owns its prompt end-to-end); callers fall back to a plain
+        ``submit`` of the full prompt.
+        """
+        if not prefix_ids:
+            return None
+        max_len = self.engine.max_pages_per_seq * self.engine.page_size
+        if len(prefix_ids) + sampling.max_new_tokens > max_len:
+            return None  # the full prompt could never fit either
+        if self.engine._use_ring_prefill(len(prefix_ids)):
+            return None
+        handle = await self.submit(
+            seq_id, prefix_ids, sampling, conversation_id=conversation_id
+        )
+        # no await ran between submit() appending to pending and here (the
+        # scheduler loop is a separate task), so the hold flags are set
+        # before admission can see the handle
+        handle.held = True
+        handle.held_deadline = time.perf_counter() + self.HOLD_TTL_S
+        METRICS.inc("finchat_partial_holds_total")
+        return handle
+
+    def extend_prompt(self, handle: SequenceHandle, full_ids: list[int]) -> bool:
+        """Graft the full prompt onto a parked/prefilling hold. Returns
+        False — leaving the hold untouched, the caller cancels and falls
+        back to a plain submit — when the graft would invalidate what was
+        already prefilled (``full_ids`` does not extend the held prefix,
+        e.g. history was windowed away after the hold was taken) or the
+        extra KV pages can't be had."""
+        if handle.finished or not handle.held:
+            return False
+        prefix = handle.prompt_ids
+        if len(full_ids) <= len(prefix) or full_ids[: len(prefix)] != prefix:
+            METRICS.inc("finchat_partial_fallbacks_total")
+            return False
+        max_len = self.engine.max_pages_per_seq * self.engine.page_size
+        if len(full_ids) + handle.sampling.max_new_tokens > max_len:
+            METRICS.inc("finchat_partial_fallbacks_total")
+            return False
+        if handle.slot >= 0:
+            total = pages_needed(
+                len(full_ids) + handle.sampling.max_new_tokens,
+                self.engine.page_size,
+            )
+            extra = total - len(handle.page_list)
+            if extra > 0:
+                if total > self.engine.max_pages_per_seq or not self.allocator.can_allocate(extra):
+                    METRICS.inc("finchat_partial_fallbacks_total")
+                    return False
+                new_pages = self.allocator.allocate(handle.seq_id, extra)
+                handle.page_list = handle.page_list + new_pages
+                self.engine.set_page_table_rows({handle.slot: handle.page_list})
+        handle.prompt_ids = list(full_ids)
+        handle.history = list(full_ids)
+        handle.held = False
+        handle.grafted = True
+        METRICS.inc("finchat_partial_grafts_total")
+        self._wakeup.set()
+        return True
+
+    def _prefill_work(self) -> bool:
+        """True when a prefill round has something to advance — parked
+        holds (prefix done, awaiting extend) are NOT work, so an otherwise
+        idle loop can sleep on the wakeup event instead of spinning."""
+        return any(
+            not (h.held and h.prefill_pos >= len(h.prompt_ids))
+            for h in self.prefilling
+        )
+
+    def _reap_stale_holds(self) -> None:
+        now = time.perf_counter()
+        for handle in list(self.prefilling):
+            if handle.held and now > handle.held_deadline:
+                logger.warning(
+                    "partial hold %s expired after %.0fs without extend_prompt; "
+                    "reclaiming its slot and pages", handle.seq_id, self.HOLD_TTL_S,
+                )
+                METRICS.inc("finchat_partial_stale_reaps_total")
+                self._evict(handle, "error", error="partial hold expired")
+        for handle in list(self.pending):
+            if handle.held and now > handle.held_deadline:
+                METRICS.inc("finchat_partial_stale_reaps_total")
+                self.pending.remove(handle)
+                handle.finished = True
+                handle.span.finish()
+                handle.events.put_nowait(
+                    {"type": "error", "message": "partial hold expired"}
+                )
 
     def register_prefix(self, prompt_ids: list[int]) -> int:
         """Prefill a shared prompt head ONCE and serve its KV to every
@@ -723,9 +842,15 @@ class ContinuousBatchingScheduler:
         # (handle, device logits row) pairs whose prompt completed this round
         completions: list[tuple[SequenceHandle, object]] = []
         for handle in list(self.prefilling):
+            if handle.held and handle.prefill_pos >= len(handle.prompt_ids):
+                continue  # parked: prefix done, awaiting extend_prompt
             try:
                 inject("scheduler.prefill", seq_id=handle.seq_id)
+                # a grafted hold stays on the chunked path even if the
+                # full prompt is ring-length: both ring paths assume they
+                # scheduled the prompt from position 0 themselves
                 if eng._use_ring_prefill(len(handle.prompt_ids)) \
+                        and not handle.grafted \
                         and (handle.prefill_pos == 0 or handle.ring_path
                              or handle.prefix_entry is not None):
                     rc = eng.ring_segment_tokens()
@@ -796,6 +921,9 @@ class ContinuousBatchingScheduler:
             for i, handle in enumerate(batch):
                 handle.prefill_pos += int(n_valids[i])
                 if handle.prefill_pos >= len(handle.prompt_ids):
+                    if handle.held:
+                        continue  # park: the first token commits only
+                        # after extend_prompt grafts the real prompt end
                     completions.append((handle, logits[i]))
             for i, job in enumerate(jobs, start=len(batch)):
                 job.pos += int(n_valids[i])
@@ -1184,8 +1312,12 @@ class ContinuousBatchingScheduler:
         logger.info("scheduler loop started (max_seqs=%d)", self.engine.engine_cfg.max_seqs)
         inflight: _InFlightStep | _InFlightBlock | None = None
         while self._running:
-            if not (self.pending or self.prefilling or self.decoding
-                    or self._prefix_jobs):
+            self._reap_stale_holds()
+            # parked holds (prefix prefilled, waiting for extend_prompt)
+            # are not work: without the _prefill_work() refinement the
+            # loop would busy-spin for the whole retrieval latency
+            if not (self.pending or self.decoding or self._prefix_jobs
+                    or self._prefill_work()):
                 if inflight is not None:  # drain the pipeline before idling
                     await self._consume_inflight(inflight)
                     inflight = None

@@ -156,6 +156,26 @@ def render_chat_head(system_prompt: str) -> str:
     return f"{_ROLE_TAGS['system']}\n{system_prompt}\n"
 
 
+def render_chat_prefix(
+    system_prompt: str,
+    context: str,
+    history: Sequence[ChatMessage],
+) -> str:
+    """Everything of a rendered prompt that is known BEFORE the final user
+    turn's content: system turn (system + context), the chat history, and
+    the opening user tag. BY CONSTRUCTION a byte prefix of ``render_chat``
+    with the same arguments (render_chat builds from this), so the
+    retrieval/prefill overlap plane can prefill it while retrieval is
+    still deciding what the user turn will carry — the two can never
+    drift apart."""
+    parts = [f"{render_chat_head(system_prompt)}{context}\n"]
+    for turn in history:
+        role = "user" if turn.is_user else "assistant"
+        parts.append(f"{_ROLE_TAGS[role]}\n{turn.message}\n")
+    parts.append(f"{_ROLE_TAGS['user']}\n")
+    return "".join(parts)
+
+
 def render_chat(
     system_prompt: str,
     context: str,
@@ -168,10 +188,7 @@ def render_chat(
     holding ``{system_prompt}\\n{context}``, then the chat history in order,
     then the new user turn, then the assistant tag left open for generation.
     """
-    parts = [f"{render_chat_head(system_prompt)}{context}\n"]
-    for turn in history:
-        role = "user" if turn.is_user else "assistant"
-        parts.append(f"{_ROLE_TAGS[role]}\n{turn.message}\n")
-    parts.append(f"{_ROLE_TAGS['user']}\n{user_input}\n")
-    parts.append(f"{_ROLE_TAGS['assistant']}\n")
-    return "".join(parts)
+    return (
+        f"{render_chat_prefix(system_prompt, context, history)}"
+        f"{user_input}\n{_ROLE_TAGS['assistant']}\n"
+    )

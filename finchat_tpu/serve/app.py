@@ -328,8 +328,21 @@ class App:
         self._prefix_refresh_task: asyncio.Task | None = None
 
     # --- lifespan -------------------------------------------------------
+    def _embed_batcher(self):
+        """The embedding microbatcher, wherever it is wired: the app's own
+        ingestion retriever or the agent's (they are the same object on
+        the default on-device path)."""
+        return getattr(self.retriever, "batcher", None) or getattr(
+            self.agent.retriever, "batcher", None
+        )
+
     async def start(self, serve_http: bool = True) -> None:
         await self.store.check_connection()
+        batcher = self._embed_batcher()
+        if batcher is not None:
+            # bind the coalescing flusher to the serving loop so the
+            # threadsafe ingest path can ride the same window as queries
+            batcher.bind_loop()
         topics = [USER_MESSAGE_TOPIC]
         if self.retriever is not None:
             topics.append(TRANSACTION_UPSERT_TOPIC)
@@ -361,6 +374,9 @@ class App:
             task.cancel()
         if self._inflight:
             await asyncio.gather(*self._inflight, return_exceptions=True)
+        batcher = self._embed_batcher()
+        if batcher is not None:
+            await batcher.close()
         if self.scheduler is not None:
             await self.scheduler.stop()
         self._persist_index(force=True)
@@ -707,6 +723,7 @@ def build_app(cfg: AppConfig | None = None, *, store: ConversationStore | None =
         response_generator = response_generator or resp_gen
 
     if retriever is None:
+        from finchat_tpu.embed.batcher import EmbedMicrobatcher
         from finchat_tpu.embed.encoder import EMBED_PRESETS, EmbeddingEncoder, init_bert_params
         from finchat_tpu.embed.index import DeviceVectorIndex
 
@@ -758,8 +775,16 @@ def build_app(cfg: AppConfig | None = None, *, store: ConversationStore | None =
                 index = DeviceVectorIndex.load(base, dim=embed_cfg.dim)
             else:
                 index = DeviceVectorIndex(dim=embed_cfg.dim)
+            # the embedding microbatcher coalesces concurrent query embeds
+            # and ingest upserts into shared encode_batch dispatches; it
+            # binds to the serving event loop at App.start
+            batcher = EmbedMicrobatcher(
+                encoder, window_ms=cfg.embed.batch_window_ms,
+                max_batch=cfg.embed.batch_max,
+            )
             retriever = TransactionRetriever(
-                encoder, index, default_limit=cfg.vector.default_limit
+                encoder, index, default_limit=cfg.vector.default_limit,
+                batcher=batcher,
             )
 
     system_prompt, tool_prompt = load_prompts()
@@ -769,6 +794,7 @@ def build_app(cfg: AppConfig | None = None, *, store: ConversationStore | None =
             temperature=cfg.engine.temperature, top_p=cfg.engine.top_p,
             top_k=cfg.engine.top_k, max_new_tokens=cfg.engine.max_new_tokens,
         ),
+        retrieval_overlap=cfg.engine.retrieval_overlap,
     )
     # the App's ingestion endpoints work with any backend exposing
     # upsert_transactions (device index or external Qdrant); snapshot

@@ -53,6 +53,13 @@ def _env_int(name: str, default: int) -> int:
     return int(raw)
 
 
+def _env_float(name: str, default: float) -> float:
+    raw = os.getenv(name)
+    if raw is None or raw == "":
+        return default
+    return float(raw)
+
+
 @dataclass
 class KafkaConfig:
     """Transport settings; mirrors reference ``config.py:8-28``."""
@@ -214,6 +221,12 @@ class EngineConfig:
     # Grammar-constrained, spec-decode, and within-K-of-budget slots are
     # demoted to single-step by the scheduler. Bench at 4/8.
     decode_loop_depth: int = 1
+    # retrieval/prefill overlap (agent/graph.py + scheduler submit_partial):
+    # prefill the response prompt's static prefix (system + context +
+    # history) WHILE the retrieval tool's embed+search run, grafting the
+    # retrieved block when it arrives; falls back to the serial path
+    # whenever the graft would invalidate already-prefilled KV
+    retrieval_overlap: bool = True
     # chunked ring prefill: segment size (tokens) for the seq-sharded
     # prefill. > 0 splits a ring-eligible prompt into segments that
     # interleave with decode steps in the scheduler loop (each segment
@@ -240,6 +253,13 @@ class EmbedConfig:
     checkpoint_path: str = ""
     tokenizer_path: str = ""
     batch_size: int = 64
+    # cross-request embedding microbatcher (embed/batcher.py): concurrent
+    # query embeds + ingest upserts coalesce into one bucket-padded
+    # encode_batch dispatch. batch_window_ms = how long the first arrival
+    # waits for company (0 = dispatch immediately, coalescing only what is
+    # already queued); batch_max = texts per coalesced dispatch.
+    batch_window_ms: float = 3.0
+    batch_max: int = 32
 
 
 @dataclass
@@ -309,6 +329,10 @@ def load_config(
     cfg.model.quant = _env("FINCHAT_QUANT", cfg.model.quant)
     cfg.embed.checkpoint_path = _env("FINCHAT_EMBED_CHECKPOINT", cfg.embed.checkpoint_path)
     cfg.embed.tokenizer_path = _env("FINCHAT_EMBED_TOKENIZER", cfg.embed.tokenizer_path)
+    cfg.embed.batch_window_ms = _env_float(
+        "FINCHAT_EMBED_BATCH_WINDOW_MS", cfg.embed.batch_window_ms
+    )
+    cfg.embed.batch_max = _env_int("FINCHAT_EMBED_BATCH_MAX", cfg.embed.batch_max)
     cfg.engine.max_seqs = _env_int("FINCHAT_MAX_SEQS", cfg.engine.max_seqs)
     cfg.engine.warmup_on_start = _env_bool("FINCHAT_WARMUP", cfg.engine.warmup_on_start)
     cfg.engine.ring_prefill_min_tokens = _env_int(
@@ -327,6 +351,9 @@ def load_config(
     cfg.engine.session_cache = _env_bool("FINCHAT_SESSION_CACHE", cfg.engine.session_cache)
     cfg.engine.session_cache_bytes = _env_int(
         "FINCHAT_SESSION_CACHE_BYTES", cfg.engine.session_cache_bytes
+    )
+    cfg.engine.retrieval_overlap = _env_bool(
+        "FINCHAT_RETRIEVAL_OVERLAP", cfg.engine.retrieval_overlap
     )
     cfg.serve.port = _env_int("FINCHAT_PORT", cfg.serve.port)
 

@@ -22,6 +22,25 @@ resume), ``finchat_session_cache_offloaded_pages_total``,
 ``finchat_session_cache_truncations_total`` (divergent-history cuts), and
 the ``finchat_session_offload_seconds`` / ``finchat_session_restore_seconds``
 histograms (D2H snapshot / H2D resume latency).
+
+Retrieval-plane family (embed/batcher.py microbatcher, embed/index.py
+batched search, agent/scheduler overlap):
+``finchat_embed_batch_occupancy`` (gauge — texts in the last coalesced
+dispatch), ``finchat_embed_queue_depth`` (gauge — texts awaiting a
+dispatch), ``finchat_embed_batch_dispatches_total`` /
+``finchat_embed_requests_total`` / ``finchat_embed_texts_total``
+(dispatches ÷ requests is the coalescing figure of merit; < 1 means the
+wait-window is batching cross-request), ``finchat_embed_batch_retries_total``
+(coalesced dispatch failed, per-request isolation retries),
+``finchat_embed_failures_total``, ``finchat_embed_wait_seconds``
+(histogram — queueing delay the window adds), and the per-stage retrieval
+latency histograms ``finchat_retrieval_embed_seconds`` /
+``finchat_retrieval_search_seconds`` / ``finchat_retrieval_graft_seconds``.
+Overlap counters: ``finchat_partial_holds_total`` (static-prefix prefills
+started), ``finchat_partial_grafts_total`` (extend_prompt grafted the
+full prompt onto a hold), ``finchat_partial_fallbacks_total`` (graft
+would have invalidated prefilled KV — serial fallback), and
+``finchat_partial_stale_reaps_total`` (abandoned holds reclaimed).
 """
 
 from __future__ import annotations
