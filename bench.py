@@ -106,6 +106,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--retrieval-smoke", action="store_true",
                    help="tiny --retrieval-sweep variant for CI: fewer "
                         "rounds/repeats, coalescing+identity checks only")
+    p.add_argument("--mixed-sweep", action="store_true",
+                   help="CPU-runnable benchmark of the unified mixed "
+                        "prefill+decode step (engine mixed_step): greedy "
+                        "decode streams run while a long prompt is "
+                        "admitted mid-decode, mixed off (split: prefill "
+                        "round + decode dispatch per iteration) vs on "
+                        "(one ragged dispatch). Reports model dispatches "
+                        "per coexist-iteration (2→1), decode inter-token "
+                        "p50/p99 during the admission window, and asserts "
+                        "greedy outputs byte-identical")
+    p.add_argument("--mixed-smoke", action="store_true",
+                   help="tiny --mixed-sweep variant for CI: fewer "
+                        "episodes, fusion+identity gates only")
     p.add_argument("--tpu-timeout", type=float, default=180.0,
                    help="seconds allowed for TPU backend INIT before the "
                         "child is declared hung (measurement gets "
@@ -157,7 +170,9 @@ def run_worker(args: argparse.Namespace) -> int:
     faulthandler.dump_traceback_later(max(60.0, args.measure_budget - 10.0), exit=True)
 
     work = resolve_workload(args, "tpu" if platform == "tpu" else "cpu")
-    if args.retrieval_sweep:
+    if args.mixed_sweep:
+        result = measure_mixed_sweep(smoke=args.mixed_smoke)
+    elif args.retrieval_sweep:
         result = measure_retrieval_sweep(
             concurrency=tuple(int(c) for c in args.retrieval_concurrency.split(",")),
             windows_ms=tuple(float(w) for w in args.retrieval_windows_ms.split(",")),
@@ -949,6 +964,188 @@ def measure_retrieval_sweep(
     }
 
 
+def measure_mixed_sweep(smoke: bool = False) -> dict:
+    """Benchmark the unified mixed prefill+decode step (ISSUE 4),
+    CPU-runnable through the REAL scheduler.
+
+    Workload: greedy decode streams run steady-state; once each has
+    emitted a couple of tokens, a long multi-chunk prompt is submitted so
+    its prefill coexists with the live decodes (the admission-stall case).
+    Each episode's window runs from the long prompt's submission to its
+    first token. Measured once with ``engine.mixed_step`` off (split path:
+    one prefill round + one decode dispatch per scheduler iteration) and
+    once on (one ragged mixed dispatch per iteration):
+
+    - model dispatches per coexist-iteration, counted at the engine
+      dispatch seams (finchat_prefill_seconds_count +
+      finchat_decode_dispatches_total + finchat_mixed_dispatches_total
+      over finchat_coexist_iterations_total) — the 2→1 headline;
+    - the decode streams' host-observed inter-token p50/p99 inside the
+      admission window — the latency the fusion exists to cut;
+    - greedy byte-identity of every stream across the two modes.
+
+    The identity check runs at fp32: a decode row computes at the ragged
+    [rows, chunk] shape in mixed mode vs [max_seqs, 1] in split mode, and
+    under bf16 a last-ulp difference in the KV written during a mixed
+    round can flip a LATER near-tie argmax (the same chunk-width caveat
+    verify_step documents — either stream is a valid greedy decode). fp32
+    pins the math identity so a structural bug cannot hide behind rounding.
+    """
+    import asyncio
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from finchat_tpu.engine.engine import InferenceEngine
+    from finchat_tpu.engine.kv_cache import pages_needed
+    from finchat_tpu.engine.sampler import SamplingParams
+    from finchat_tpu.engine.scheduler import ContinuousBatchingScheduler
+    from finchat_tpu.models.llama import PRESETS, init_params
+    from finchat_tpu.utils.config import EngineConfig
+    from finchat_tpu.utils.metrics import METRICS
+
+    config = dataclasses.replace(PRESETS["mini"], dtype=jnp.float32)
+    page_size = 16
+    chunk = 32
+    n_dec = 3
+    long_chunks = 6 if smoke else 10
+    long_len = chunk * long_chunks
+    dec_budget = 48 if smoke else 72
+    long_budget = 4
+    episodes = 2 if smoke else 3  # measured episodes (plus one warm one)
+    max_seq_len = long_len + 2 * page_size + long_budget
+    pps = pages_needed(max_seq_len, page_size)
+    rng = np.random.default_rng(0)
+    dec_prompts = [
+        rng.integers(1, config.vocab_size, size=12 + 3 * i).tolist()
+        for i in range(n_dec)
+    ]
+    long_prompt = rng.integers(1, config.vocab_size, size=long_len).tolist()
+    window_keys = (
+        "finchat_prefill_seconds_count",
+        "finchat_decode_dispatches_total",
+        "finchat_mixed_dispatches_total",
+        "finchat_coexist_iterations_total",
+    )
+
+    def run(mixed: bool) -> dict:
+        ecfg = EngineConfig(
+            max_seqs=n_dec + 2, page_size=page_size,
+            num_pages=(n_dec + 2) * pps + 8, max_seq_len=max_seq_len,
+            prefill_chunk=chunk, mixed_step=mixed, session_cache=False,
+        )
+        engine = InferenceEngine(config, init_params(config, jax.random.key(0)), ecfg)
+        engine.warmup()  # compiles excluded from every episode's window
+        sched = ContinuousBatchingScheduler(engine, eos_id=-1)
+        gaps: list = []
+        win = {k: 0.0 for k in window_keys}
+
+        async def drain(handle, out):
+            while True:
+                ev = await handle.events.get()
+                if ev["type"] == "token":
+                    out.append((time.perf_counter(), ev["token_id"]))
+                elif ev["type"] == "done":
+                    return
+                else:
+                    raise RuntimeError(str(ev))
+
+        async def go():
+            all_streams = []
+            await sched.start()
+            try:
+                for ep in range(episodes + 1):  # episode 0 warms steady state
+                    handles = [
+                        await sched.submit(
+                            f"dec{ep}-{i}", dec_prompts[i],
+                            SamplingParams(temperature=0.0, max_new_tokens=dec_budget),
+                        )
+                        for i in range(n_dec)
+                    ]
+                    outs = [[] for _ in handles]
+                    tasks = [asyncio.create_task(drain(h, o))
+                             for h, o in zip(handles, outs)]
+                    while any(len(o) < 2 for o in outs):
+                        await asyncio.sleep(0.002)
+                    snap0 = METRICS.snapshot()
+                    t_submit = time.perf_counter()
+                    lh = await sched.submit(
+                        f"long{ep}", long_prompt,
+                        SamplingParams(temperature=0.0, max_new_tokens=long_budget),
+                    )
+                    lo: list = []
+                    ltask = asyncio.create_task(drain(lh, lo))
+                    while not lo:
+                        await asyncio.sleep(0.001)
+                    snap1 = METRICS.snapshot()
+                    t_first = lo[0][0]
+                    await asyncio.gather(*tasks, ltask)
+                    if ep == 0:
+                        continue
+                    for k in window_keys:
+                        win[k] += snap1.get(k, 0) - snap0.get(k, 0)
+                    for o in outs:
+                        ts = [t for t, _ in o if t_submit <= t <= t_first]
+                        gaps.extend(np.diff(ts).tolist())
+                    all_streams.append(
+                        [[t for _, t in o] for o in outs] + [[t for _, t in lo]]
+                    )
+                return all_streams
+            finally:
+                await sched.stop()
+
+        streams = asyncio.run(go())
+        iters = max(win["finchat_coexist_iterations_total"], 1.0)
+        dispatches = (win["finchat_prefill_seconds_count"]
+                      + win["finchat_decode_dispatches_total"]
+                      + win["finchat_mixed_dispatches_total"])
+        return {
+            "streams": streams,
+            "dpi": dispatches / iters,
+            "window": {k: int(v) for k, v in win.items()},
+            "gaps": gaps,
+        }
+
+    split = run(False)
+    mixed = run(True)
+
+    def pct(gaps: list, q: float) -> float:
+        if not gaps:
+            return 0.0
+        return round(1000 * float(np.quantile(np.asarray(gaps), q)), 3)
+
+    p99_split, p99_mixed = pct(split["gaps"], 0.99), pct(mixed["gaps"], 0.99)
+    print(f"[bench] mixed sweep: dispatches/iteration "
+          f"{split['dpi']:.2f} split -> {mixed['dpi']:.2f} mixed; admission "
+          f"inter-token p99 {p99_split} -> {p99_mixed} ms",
+          file=sys.stderr, flush=True)
+
+    return {
+        "metric": "mixed_sweep",
+        "unit": "dispatches/iteration, inter-token ms",
+        "smoke": smoke,
+        "model": "mini (fp32 — see identity note in measure_mixed_sweep)",
+        "prefill_chunk": chunk,
+        "long_prompt_chunks": long_chunks,
+        "decode_streams": n_dec,
+        "episodes": episodes,
+        "dispatches_per_iteration_split": round(split["dpi"], 3),
+        "dispatches_per_iteration_mixed": round(mixed["dpi"], 3),
+        "window_split": split["window"],
+        "window_mixed": mixed["window"],
+        "admission_intertoken_p50_ms_split": pct(split["gaps"], 0.5),
+        "admission_intertoken_p50_ms_mixed": pct(mixed["gaps"], 0.5),
+        "admission_intertoken_p99_ms_split": p99_split,
+        "admission_intertoken_p99_ms_mixed": p99_mixed,
+        "admission_p99_improved": p99_mixed < p99_split,
+        "greedy_outputs_identical": mixed["streams"] == split["streams"],
+        "platform": jax.devices()[0].platform,
+        "device": str(jax.devices()[0]),
+    }
+
+
 # --------------------------------------------------------------------------
 # Orchestrator: jax-free; spawns workers, never hangs, always prints JSON.
 # --------------------------------------------------------------------------
@@ -974,6 +1171,10 @@ def spawn_worker(args: argparse.Namespace, platform: str, timeout: float) -> dic
                 "--retrieval-windows-ms", args.retrieval_windows_ms]
         if args.retrieval_smoke:
             cmd += ["--retrieval-smoke"]
+    if args.mixed_sweep:
+        cmd += ["--mixed-sweep"]
+        if args.mixed_smoke:
+            cmd += ["--mixed-smoke"]
     print(f"[bench] spawning {platform} worker (timeout {timeout:.0f}s)",
           file=sys.stderr, flush=True)
     try:

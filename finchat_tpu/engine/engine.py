@@ -17,6 +17,13 @@ point has ONE static shape per (batch-bucket) —
   decode iterations per dispatch (on-device sampling + per-slot EOS mask
   inside a ``fori_loop``) — the host pays one dispatch and one
   ``[K, max_seqs]`` token fetch per K tokens instead of per token.
+- ``mixed_step``: ONE ragged ``[rows, chunk]`` dispatch advancing every
+  prefilling sequence a chunk AND every decoding slot a token together —
+  decode rows are length-1 rows of the same batch, reading their input
+  token and position from device state, with on-device sampling for
+  decode rows and completing prefill rows. The scheduler's mixed path
+  (engine.mixed_step config, default on) cuts a coexisting iteration from
+  two serialized model dispatches to one.
 
 State is donated on every call and the KV cache is updated IN PLACE by the
 Pallas append kernel (ops/kv_append.py) on the decode path — XLA's scatter
@@ -505,6 +512,104 @@ def decode_step(
     return new_state, next_tokens, (step_logits if return_logits else None)
 
 
+@partial(jax.jit, static_argnames=("config", "page_size", "attn_backend"), donate_argnums=(1,))
+def mixed_step(
+    params: dict[str, Any],
+    state: DecodeState,
+    tokens: Array,  # [N, C] — prefill rows' chunk tokens (decode rows ignored)
+    slots: Array,  # [N] int32
+    start_pos: Array,  # [N] int32 — prefill rows (decode rows read context_lens)
+    n_valid: Array,  # [N] int32 — chunk len per prefill row, 1 per decode row, 0 pad
+    is_decode: Array,  # [N] bool — input token + start position come from device state
+    arm: Array,  # [N] bool — sample a next token and arm the slot's last_tokens
+    temperature: Array,  # [N] — PER-ROW sampling params (host-gathered by slot)
+    top_p: Array,  # [N]
+    top_k: Array,  # [N] int32
+    *,
+    config: LlamaConfig,
+    page_size: int,
+    attn_backend: str = "ref",
+) -> tuple[DecodeState, Array, Array]:
+    """ONE ragged dispatch advancing prefill chunks AND decode tokens
+    together (the scheduler's mixed path, ISSUE 4): rows are either a
+    prefill chunk (``n_valid`` up to C) or a single decode token
+    (``is_decode``, ``n_valid = 1``) of the same ``[N, C]`` batch, so a
+    scheduler iteration with both populations pays one weights-read and
+    one dispatch boundary instead of a serialized prefill round plus a
+    decode step (Ragged Paged Attention / Kernel Looping, PAPERS.md).
+    Returns (state, next_tokens [N], last-valid-token logits [N, vocab]).
+
+    - Decode rows read their input token from ``state.last_tokens[slot]``
+      and their position from ``state.context_lens[slot]`` ON DEVICE, so
+      the host needs no fetch before dispatching the next round. Their
+      padding columns (1..C-1) compute but are causally downstream of
+      nothing — column 0's output is exactly the ``decode_step`` math.
+    - ``arm`` rows (decode rows AND prefill rows whose prompt completes
+      this chunk — the host knows at dispatch) sample their next token
+      from the last-valid-row logits with per-row sampling params and
+      write it into ``last_tokens``; a completing prefill row's sampled
+      token IS its first generated token, greedy-identical to
+      ``commit_first_token`` without the extra micro-dispatch. One rng
+      split per mixed step (same discipline as ``decode_step``): greedy
+      streams are byte-identical to the split path; non-greedy streams
+      are distribution-equal but consume the rng in a different order.
+    - KV lands via the chunk scatter for ALL rows (one full-cache copy
+      per round, already paid by the prefill side); ``last_tokens`` is
+      updated as a DELTA scatter-add so the duplicate-slot padding rows
+      (delta 0) cannot race the real row's write.
+
+    Host contract (scheduler ``_use_mixed``): no grammar-constrained,
+    spec-decode, decode-loop, or ring/seq-sharded rows ride a mixed step —
+    those demote the iteration to the split path.
+
+    Numerics contract (tests/test_mixed_step.py, bench --mixed-sweep): the
+    mixed path is the same MATH as the split path, and greedy streams are
+    byte-identical at fp32 (CI-gated). At bf16 the caveat ``verify_step``
+    documents applies here too: a decode row computes at the ragged
+    [rows, chunk] shape instead of [max_seqs, 1], so a last-ulp KV
+    difference can flip a later near-tie argmax — either stream is a valid
+    greedy decode of the same weights.
+    """
+    N, C = tokens.shape
+    row_last = state.last_tokens[slots]  # [N]
+    row_start = jnp.where(is_decode, state.context_lens[slots], start_pos)
+    tokens = tokens.at[:, 0].set(jnp.where(is_decode, row_last, tokens[:, 0]))
+    positions = row_start[:, None] + jnp.arange(C)[None, :]  # [N, C]
+    page_rows = state.page_table[slots]  # [N, max_pages]
+
+    attention = _paged_attention_fn(
+        page_rows, row_start, n_valid, page_size, config.n_kv_heads, attn_backend
+    )
+    # hidden states only, then project each row's last valid position —
+    # same [N, vocab]-not-[N, C, vocab] memory argument as prefill_step
+    hidden, (k_pages, v_pages, k_scales, v_scales) = forward(
+        params, tokens, positions,
+        config=config, attention=attention,
+        cache=(state.k_pages, state.v_pages, state.k_scales, state.v_scales),
+        return_hidden=True,
+    )
+    last_hidden = jnp.take_along_axis(
+        hidden, jnp.maximum(n_valid - 1, 0)[:, None, None], axis=1
+    )[:, 0]  # [N, D]
+    last_logits = lm_head(params, last_hidden, config=config)  # [N, vocab]
+
+    rng, sub = jax.random.split(state.rng)
+    next_tokens = sample(last_logits, sub, temperature, top_p, top_k)  # [N]
+    delta = jnp.where(arm, next_tokens - row_last, 0)
+
+    new_state = dataclasses.replace(
+        state,
+        k_pages=k_pages,
+        v_pages=v_pages,
+        k_scales=k_scales,
+        v_scales=v_scales,
+        context_lens=state.context_lens.at[slots].add(n_valid),
+        last_tokens=state.last_tokens.at[slots].add(delta),
+        rng=rng,
+    )
+    return new_state, next_tokens, last_logits
+
+
 @partial(
     jax.jit,
     static_argnames=("config", "page_size", "attn_backend", "loop_depth"),
@@ -708,6 +813,23 @@ class InferenceEngine:
 
         if quant and quant != "int8":
             raise ValueError(f"unknown quant mode {quant!r} (supported: 'int8')")
+        if engine_cfg.compilation_cache_dir:
+            # persistent XLA compilation cache: warmup's compiles land on
+            # disk so a restarted process reloads them instead of
+            # recompiling — warmup() logs its wall time either way, so the
+            # saving is visible on the second boot. Thresholds dropped to
+            # zero: the serving variants are exactly what we want cached,
+            # however small or fast-compiling.
+            try:
+                jax.config.update(
+                    "jax_compilation_cache_dir", engine_cfg.compilation_cache_dir
+                )
+                jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+                jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+                logger.info("persistent compilation cache: %s",
+                            engine_cfg.compilation_cache_dir)
+            except Exception as e:  # older jaxlib without the knobs
+                logger.warning("compilation cache unavailable: %s", e)
         self.config = config
         self.attn_backend = attn_backend or attention_backend()
         self.engine_cfg = engine_cfg
@@ -1027,6 +1149,25 @@ class InferenceEngine:
                 config=self.config, page_size=self.page_size,
                 attn_backend=self.attn_backend,
             )
+        if cfg.mixed_step:
+            # the ragged mixed prefill+decode variants the scheduler's
+            # mixed path dispatches — pow-2 ROW buckets (prefill rows +
+            # decode rows occupy distinct slots, so their sum never
+            # exceeds max_seqs) × the CHUNK buckets of mixed_chunk_buckets
+            # (full chunk + the short-tail width); all-padding rows
+            # (n_valid = 0, nothing armed) keep it state-neutral
+            for mc in self.mixed_chunk_buckets():
+                for n in prefill_batch_sizes:
+                    zeros = jnp.zeros((n,), jnp.int32)
+                    flags = jnp.zeros((n,), bool)
+                    self.state, _, _ = mixed_step(
+                        self.params, self.state, jnp.zeros((n, mc), jnp.int32),
+                        zeros, zeros, zeros, flags, flags,
+                        jnp.zeros((n,), jnp.float32), jnp.ones((n,), jnp.float32),
+                        jnp.zeros((n,), jnp.int32),
+                        config=self.config, page_size=self.page_size,
+                        attn_backend=self.attn_backend,
+                    )
         inactive = jnp.zeros((B,), bool)
         temp = jnp.full((B,), 1.0, jnp.float32)
         top_p = jnp.ones((B,), jnp.float32)
@@ -1113,9 +1254,13 @@ class InferenceEngine:
                     pb = min(pb * 2, top_pb)
         np.asarray(self.state.context_lens)  # barrier: compilation done
         elapsed = time.perf_counter() - t0
+        cache_note = (
+            f" (compilation cache: {cfg.compilation_cache_dir})"
+            if cfg.compilation_cache_dir else ""
+        )
         logger.info(
-            "engine warmup: prefill batches %s + decode variants compiled in %.1fs",
-            prefill_batch_sizes, elapsed,
+            "engine warmup: prefill batches %s + decode variants compiled in %.1fs%s",
+            prefill_batch_sizes, elapsed, cache_note,
         )
         return elapsed
 
@@ -1129,6 +1274,36 @@ class InferenceEngine:
             attn_backend=self.attn_backend, return_logits=return_logits,
         )
         return (next_tokens, logits) if return_logits else next_tokens
+
+    def mixed_chunk_buckets(self) -> list[int]:
+        """Column-width buckets for the mixed step (ascending). A decode
+        row pays dense compute for every padded column, so a round whose
+        prefill tails are all short must not pad D decode rows to the full
+        ``prefill_chunk`` — at the production chunk (512) with a full slot
+        batch that would be a ~60× FLOPs blowup for a 20-token tail (the
+        prefix/session-cache-assisted common case). Bounded to TWO pow-2
+        buckets — ``prefill_chunk`` and ``prefill_chunk/8`` — so warmup
+        stays at 2×log2(max_seqs) mixed variants, not a full pow-2 grid."""
+        C = self.engine_cfg.prefill_chunk
+        return sorted({max(1, round_up_pow2(-(-C // 8))), C})
+
+    def mixed(self, tokens, slots, start_pos, n_valid, is_decode, arm,
+              temperature, top_p, top_k):
+        """One unified mixed prefill+decode dispatch (see mixed_step);
+        returns the sampled next-token row vector [N] (device array — the
+        scheduler fetches it once per round). Counted at the dispatch seam
+        like decode()/decode_loop(), so bench.py's dispatches-per-iteration
+        figure reads real enqueued device programs."""
+        from finchat_tpu.utils.metrics import METRICS
+
+        METRICS.inc("finchat_mixed_dispatches_total")
+        self.state, next_tokens, _last_logits = mixed_step(
+            self.params, self.state, tokens, slots, start_pos, n_valid,
+            is_decode, arm, temperature, top_p, top_k,
+            config=self.config, page_size=self.page_size,
+            attn_backend=self.attn_backend,
+        )
+        return next_tokens
 
     def decode_loop(self, active, temperature, top_p, top_k, eos_id: int,
                     depth: int | None = None):

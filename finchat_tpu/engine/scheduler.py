@@ -34,6 +34,16 @@ model replica:
   single-step (mirroring the SPEC_MISS_DEMOTE machinery) and rejoin
   blocks when eligibility returns; slots that finish mid-block free-run
   into the trash page and their tail iterations are counted as waste.
+- Unified mixed prefill+decode step (``engine.mixed_step``, default on):
+  when prefill work and in-flight decodes coexist, the iteration runs ONE
+  ragged ``mixed_step`` dispatch — every prefilling row advances a chunk
+  and every decoding row a token as a length-1 row of the same batch,
+  with on-device sampling for decode rows and completing prefill rows —
+  instead of a serialized prefill round plus a decode step. Spec decode,
+  decode_loop blocks, grammar-constrained picks, and ring/seq-sharded
+  prefill demote the iteration to the split path below, which remains the
+  golden-identical fallback (greedy streams are byte-identical either
+  way; tests/test_mixed_step.py pins it).
 - Session KV cache (engine/session_cache.py): sequences submitted with a
   ``conversation_id`` snapshot their KV pages device→host when they retire
   normally (eos/length, before the pages are freed) and the conversation's
@@ -128,6 +138,10 @@ class SequenceHandle:
     grafted: bool = False
     submitted_at: float = field(default_factory=time.perf_counter)
     first_token_at: float | None = None
+    # host arrival time of the last delivered token — feeds the
+    # finchat_inter_token_seconds histogram (labeled by whether the
+    # emitting iteration also ran prefill work)
+    last_token_at: float | None = None
     finished: bool = False
     span: RequestSpan = None  # type: ignore[assignment]  # set in __post_init__
 
@@ -246,6 +260,15 @@ class ContinuousBatchingScheduler:
         # and spec-decode iterations keep their own depth-1 verify cadence
         self.loop_depth = engine.decode_loop_depth
         METRICS.set_gauge("finchat_decode_loop_depth", self.loop_depth)
+        # unified mixed prefill+decode step (engine.mixed_step config): one
+        # ragged dispatch advances every prefilling row a chunk AND every
+        # decoding row a token whenever both populations exist and nothing
+        # needs its own dispatch schedule — see _use_mixed / _mixed_round
+        self.mixed_enabled = bool(cfg.mixed_step)
+        # whether the CURRENT loop iteration ran (or will run) prefill
+        # work — the finchat_inter_token_seconds label distinguishing the
+        # admission-stall case from steady decode
+        self._iter_ran_prefill = False
         # shared-prefix KV cache: matched at admission so identical prompt
         # heads (the constant system prompt every conversation shares) are
         # prefilled ONCE per process instead of per request — see
@@ -410,14 +433,33 @@ class ContinuousBatchingScheduler:
         self._wakeup.set()
         return True
 
+    def _ring_routed(self, handle: SequenceHandle) -> bool:
+        """Does this prefilling handle take the seq-sharded ring path this
+        round (prefill_ring / prefill_ring_segment) rather than the chunked
+        batch? The ONE routing predicate shared by _prefill_round and the
+        mixed-step eligibility check, so they cannot drift. (A grafted hold
+        stays chunked even if the full prompt is ring-length: both ring
+        paths assume they scheduled the prompt from position 0.)"""
+        return (
+            self.engine._use_ring_prefill(len(handle.prompt_ids))
+            and not handle.grafted
+            and (handle.prefill_pos == 0 or handle.ring_path
+                 or handle.prefix_entry is not None)
+        )
+
+    @staticmethod
+    def _parked(handle: SequenceHandle) -> bool:
+        """A parked overlap hold: prefix prefilled, awaiting extend_prompt
+        — not prefill work, never part of a dispatched round. The ONE
+        predicate shared by the round builders, the round-failure handler,
+        and the idle check, so they cannot drift."""
+        return handle.held and handle.prefill_pos >= len(handle.prompt_ids)
+
     def _prefill_work(self) -> bool:
         """True when a prefill round has something to advance — parked
-        holds (prefix done, awaiting extend) are NOT work, so an otherwise
-        idle loop can sleep on the wakeup event instead of spinning."""
-        return any(
-            not (h.held and h.prefill_pos >= len(h.prompt_ids))
-            for h in self.prefilling
-        )
+        holds are NOT work, so an otherwise idle loop can sleep on the
+        wakeup event instead of spinning."""
+        return any(not self._parked(h) for h in self.prefilling)
 
     def _reap_stale_holds(self) -> None:
         now = time.perf_counter()
@@ -842,17 +884,11 @@ class ContinuousBatchingScheduler:
         # (handle, device logits row) pairs whose prompt completed this round
         completions: list[tuple[SequenceHandle, object]] = []
         for handle in list(self.prefilling):
-            if handle.held and handle.prefill_pos >= len(handle.prompt_ids):
-                continue  # parked: prefix done, awaiting extend_prompt
+            if self._parked(handle):
+                continue  # awaiting extend_prompt
             try:
                 inject("scheduler.prefill", seq_id=handle.seq_id)
-                # a grafted hold stays on the chunked path even if the
-                # full prompt is ring-length: both ring paths assume they
-                # scheduled the prompt from position 0 themselves
-                if eng._use_ring_prefill(len(handle.prompt_ids)) \
-                        and not handle.grafted \
-                        and (handle.prefill_pos == 0 or handle.ring_path
-                             or handle.prefix_entry is not None):
+                if self._ring_routed(handle):
                     rc = eng.ring_segment_tokens()
                     if rc == 0:
                         assert handle.prefill_pos == 0  # monolithic never
@@ -897,17 +933,7 @@ class ContinuousBatchingScheduler:
             rows = [(h.slot, h.prompt_ids, h.prefill_pos) for h in batch]
             rows += [(j.slot, j.ids, j.pos) for j in jobs]
             N = round_up_pow2(len(rows))
-            tokens = np.zeros((N, C), np.int32)
-            slots = np.zeros((N,), np.int32)
-            starts = np.zeros((N,), np.int32)
-            n_valids = np.zeros((N,), np.int32)
-            slots[:] = rows[0][0]  # padding rows: n_valid 0 → trash writes
-            for i, (slot, ids, pos) in enumerate(rows):
-                chunk = ids[pos : pos + C]
-                tokens[i, : len(chunk)] = chunk
-                slots[i] = slot
-                starts[i] = pos
-                n_valids[i] = len(chunk)
+            tokens, slots, starts, n_valids = self._pack_prefill_rows(rows, N, C)
             with Timer(METRICS, "finchat_prefill_seconds"):
                 # host-side dispatch time for the round (device work is
                 # async; steady-state it tracks the round cadence)
@@ -928,18 +954,7 @@ class ContinuousBatchingScheduler:
             for i, job in enumerate(jobs, start=len(batch)):
                 job.pos += int(n_valids[i])
                 if job.pos >= job.shared_len:
-                    self._prefix_jobs.remove(job)
-                    self.engine.reset_slot(job.slot)
-                    self.free_slots.append(job.slot)
-                    self._prefixes.append(
-                        _PrefixEntry(job.ids, job.pages, job.shared_len, job.owner)
-                    )
-                    logger.info(
-                        "prefix cache: registered %d shared tokens (%d pages, chunked)",
-                        job.shared_len, len(job.pages),
-                    )
-                    if not job.future.done():
-                        job.future.set_result(job.shared_len)
+                    self._complete_prefix_job(job, "chunked")
 
         if not completions:
             return  # dispatch-only round, no host sync needed
@@ -977,7 +992,193 @@ class ContinuousBatchingScheduler:
                 logger.error("prefill completion error for %s: %s", handle.seq_id, e)
                 self._evict(handle, "error", error=str(e))
 
+    @staticmethod
+    def _pack_prefill_rows(rows, N: int, C: int):
+        """Ragged row arrays for a chunked round (shared by _prefill_round
+        and _mixed_round): one chunk per ``(slot, ids, pos)`` row; padding
+        rows carry the first row's slot with ``n_valid 0`` → trash writes."""
+        tokens = np.zeros((N, C), np.int32)
+        slots = np.zeros((N,), np.int32)
+        starts = np.zeros((N,), np.int32)
+        n_valids = np.zeros((N,), np.int32)
+        slots[:] = rows[0][0]
+        for i, (slot, ids, pos) in enumerate(rows):
+            chunk = ids[pos : pos + C]
+            tokens[i, : len(chunk)] = chunk
+            slots[i] = slot
+            starts[i] = pos
+            n_valids[i] = len(chunk)
+        return tokens, slots, starts, n_valids
+
+    def _complete_prefix_job(self, job: _PrefixJob, how: str) -> None:
+        """A chunked prefix registration finished its last chunk: publish
+        the entry, return the engine slot, resolve the caller's future
+        (shared by both round paths — they must stay in lock-step)."""
+        self._prefix_jobs.remove(job)
+        self.engine.reset_slot(job.slot)
+        self.free_slots.append(job.slot)
+        self._prefixes.append(
+            _PrefixEntry(job.ids, job.pages, job.shared_len, job.owner)
+        )
+        logger.info(
+            "prefix cache: registered %d shared tokens (%d pages, %s)",
+            job.shared_len, len(job.pages), how,
+        )
+        if not job.future.done():
+            job.future.set_result(job.shared_len)
+
+    def _fail_prefill_round(self, error: str) -> None:
+        """A whole-round prefill failure is not attributable to one
+        sequence: fail everything that was IN the dispatch. Parked overlap
+        holds whose prefix already finished were skipped from the round
+        (they are awaiting extend_prompt, not prefilling), so they must
+        survive — the pre-fix behavior evicted them too, failing in-flight
+        retrieval overlaps that never touched the failed dispatch."""
+        for handle in list(self.prefilling):
+            if self._parked(handle):
+                continue  # not in the failed round
+            self._evict(handle, "error", error=error)
+        for job in list(self._prefix_jobs):
+            self._fail_prefix_job(job)
+
+    def _use_mixed(self) -> bool:
+        """Can this iteration run ONE ragged mixed_step dispatch instead of
+        a prefill round plus a decode step? Both populations must exist,
+        and nothing may need its own dispatch schedule: decode_loop blocks
+        (loop_depth > 1), an eligible spec-decode verify step,
+        grammar-constrained picks (host-side, per token), and
+        ring/seq-sharded prefill rows all demote the iteration to the
+        split path — which stays golden-identical, exactly like
+        query_points vs query_points_batch on the retrieval plane."""
+        if not self.mixed_enabled or self.loop_depth > 1 or not self.decoding:
+            return False
+        if self.spec_k > 0 and self._spec_cooldown == 0 and self._spec_candidates():
+            return False
+        if any(h.constraint is not None for h in self.decoding.values()):
+            return False
+        rows = [h for h in self.prefilling if not self._parked(h)]
+        if not rows and not self._prefix_jobs:
+            return False
+        return not any(
+            self._ring_routed(h) or h.constraint is not None for h in rows
+        )
+
+    async def _mixed_round(self) -> None:
+        """Advance EVERY prefilling sequence one chunk AND every decoding
+        slot one token in a single ragged mixed_step dispatch (ISSUE 4):
+        decode rows are length-1 rows of the same [rows, chunk] batch, so
+        an iteration with both populations costs ONE model dispatch
+        instead of a prefill round plus a decode step — the admission
+        stall a long prompt used to add to every in-flight stream's
+        inter-token gap shrinks to the fused step's own time. Prefill rows
+        whose prompt completes this chunk sample their first token
+        on-device in the same dispatch (greedy-identical to
+        commit_first_token). _use_mixed() guarantees no constrained, spec,
+        decode-loop, or ring work is present."""
+        eng = self.engine
+        C = eng.engine_cfg.prefill_chunk
+        batch: list[SequenceHandle] = []
+        for handle in list(self.prefilling):
+            if self._parked(handle):
+                continue  # awaiting extend_prompt
+            try:
+                inject("scheduler.prefill", seq_id=handle.seq_id)
+            except Exception as e:  # per-sequence isolation, as in the split path
+                logger.error("prefill error for %s: %s", handle.seq_id, e)
+                self._evict(handle, "error", error=str(e))
+                continue
+            batch.append(handle)
+        jobs = list(self._prefix_jobs)
+        decode_members = list(self.decoding.items())
+        rows = [(h.slot, h.prompt_ids, h.prefill_pos) for h in batch]
+        rows += [(j.slot, j.ids, j.pos) for j in jobs]
+        if not rows or not decode_members:
+            return  # a fault above drained one side; split paths resume next tick
+        inject("scheduler.decode")
+        from finchat_tpu.engine.engine import round_up_pow2
+
+        # chunk bucket: decode rows pay dense compute for every padded
+        # column, so a round whose prefill tails are all short rides the
+        # small bucket instead of padding D decode rows to prefill_chunk
+        # (engine.mixed_chunk_buckets — warmup covers both widths)
+        need = max(min(len(ids) - pos, C) for _slot, ids, pos in rows)
+        C = next(b for b in eng.mixed_chunk_buckets() if b >= need)
+        N = round_up_pow2(len(rows) + len(decode_members))
+        tokens, slots, starts, n_valids = self._pack_prefill_rows(rows, N, C)
+        is_decode = np.zeros((N,), bool)
+        arm = np.zeros((N,), bool)
+        temp = np.zeros((N,), np.float32)
+        top_p = np.ones((N,), np.float32)
+        top_k = np.zeros((N,), np.int32)
+        completions: list[tuple[int, SequenceHandle]] = []
+        for i, h in enumerate(batch):
+            if h.held or h.prefill_pos + int(n_valids[i]) < len(h.prompt_ids):
+                continue
+            # the prompt completes this chunk: arm the row so its first
+            # token samples on-device with the sequence's own params
+            arm[i] = True
+            s = h.sampling
+            temp[i], top_p[i], top_k[i] = s.temperature, s.top_p, s.top_k
+            completions.append((i, h))
+        base = len(rows)
+        for d, (slot, _h) in enumerate(decode_members):
+            i = base + d
+            slots[i] = slot
+            n_valids[i] = 1
+            is_decode[i] = arm[i] = True
+            temp[i] = self._temperature[slot]
+            top_p[i] = self._top_p[slot]
+            top_k[i] = self._top_k[slot]
+        with Timer(METRICS, "finchat_mixed_step_seconds"):
+            next_tokens = eng.mixed(
+                jnp.asarray(tokens), jnp.asarray(slots), jnp.asarray(starts),
+                jnp.asarray(n_valids), jnp.asarray(is_decode), jnp.asarray(arm),
+                jnp.asarray(temp), jnp.asarray(top_p), jnp.asarray(top_k),
+            )
+        # prefill bookkeeping happens at dispatch: n_valid is host data
+        for i, h in enumerate(batch):
+            h.prefill_pos += int(n_valids[i])
+        for i, job in enumerate(jobs, start=len(batch)):
+            job.pos += int(n_valids[i])
+            if job.pos >= job.shared_len:
+                self._complete_prefix_job(job, "mixed")
+        # ONE host fetch serves the decode tokens AND the completions'
+        # first tokens (worker thread keeps the event loop live)
+        toks_host = await asyncio.to_thread(lambda: np.asarray(next_tokens))
+        for i, handle in completions:
+            if handle.finished:
+                continue  # cancelled while fetching
+            handle.span.mark("prefill_done")
+            try:
+                self.prefilling.remove(handle)
+                self.decoding[handle.slot] = handle
+                self._deliver(handle, int(toks_host[i]))
+            except Exception as e:  # per-sequence isolation
+                logger.error("prefill completion error for %s: %s", handle.seq_id, e)
+                self._evict(handle, "error", error=str(e))
+        for d, (slot, handle) in enumerate(decode_members):
+            if handle.finished or handle.slot != slot:
+                continue  # evicted/cancelled since dispatch; token discarded
+            self._deliver(handle, int(toks_host[base + d]))
+        METRICS.set_gauge("finchat_batch_occupancy", len(self.decoding))
+
     def _deliver(self, handle: SequenceHandle, token_id: int) -> None:
+        now = time.perf_counter()
+        if handle.last_token_at is not None:
+            # the instrument behind the mixed step's admission-stall win
+            # (ISSUE 4): inter-token gaps split by whether this iteration
+            # also ran prefill work (admission) or not (steady decode).
+            # Deliberately stamped at CONSUME time, not dispatch time: the
+            # loop awaits the prefill round BEFORE consuming the in-flight
+            # step, so a gap ending at this delivery spans the consuming
+            # iteration's prefill work — a step dispatched in steady
+            # decode but delivered behind an admission's prefill round WAS
+            # stretched by it, and must land in the "yes" series
+            METRICS.observe(
+                "finchat_inter_token_seconds", now - handle.last_token_at,
+                labels={"prefill_concurrent": "yes" if self._iter_ran_prefill else "no"},
+            )
+        handle.last_token_at = now
         handle._emit_first_token_metrics()
         handle.generated += 1
         handle.history.append(token_id)
@@ -1319,6 +1520,7 @@ class ContinuousBatchingScheduler:
             if not (self.pending or self.decoding or self._prefix_jobs
                     or self._prefill_work()):
                 if inflight is not None:  # drain the pipeline before idling
+                    self._iter_ran_prefill = False
                     await self._consume_inflight(inflight)
                     inflight = None
                     continue
@@ -1331,6 +1533,41 @@ class ContinuousBatchingScheduler:
 
             self._admit()
 
+            prefill_active = bool(self._prefix_jobs) or self._prefill_work()
+            # label for the inter-token histogram, and the denominator for
+            # the dispatches-per-iteration figure bench --mixed-sweep
+            # reports: iterations where prefill work and in-flight decodes
+            # coexist are exactly where the mixed step's 2→1 fusion applies
+            self._iter_ran_prefill = prefill_active
+            if prefill_active and self.decoding:
+                METRICS.inc("finchat_coexist_iterations_total")
+
+            if self._spec_cooldown > 0:
+                # demoted after sustained all-miss steps: count pipelined
+                # steps down to the next spec re-probe
+                self._spec_cooldown -= 1
+
+            if self._use_mixed():
+                # the mixed path is depth-1 (dispatch + consume within the
+                # iteration — the prefill side was synchronous in the split
+                # path too): drain any pipelined split-path leftover first
+                if inflight is not None:
+                    await self._consume_inflight(inflight)
+                    inflight = None
+                if self._use_mixed():  # consuming may have evicted slots
+                    try:
+                        await self._mixed_round()
+                    except Exception as e:
+                        # not attributable to one sequence: fail the
+                        # round's prefill rows AND the decode members that
+                        # rode the same dispatch, keep serving
+                        logger.error("mixed step error: %s", e)
+                        self._fail_prefill_round(str(e))
+                        for handle in list(self.decoding.values()):
+                            self._evict(handle, "error", error=str(e))
+                    await asyncio.sleep(0)  # let producers/consumers run
+                    continue
+
             # one batched prefill round (all prefilling sequences advance a
             # chunk together), interleaved with decode so TTFT work cannot
             # starve in-flight streams
@@ -1338,18 +1575,9 @@ class ContinuousBatchingScheduler:
                 try:
                     await self._prefill_round()
                 except Exception as e:
-                    # a whole-round failure is not attributable to one
-                    # sequence: fail everything in the round, keep serving
                     logger.error("prefill round error: %s", e)
-                    for handle in list(self.prefilling):
-                        self._evict(handle, "error", error=str(e))
-                    for job in list(self._prefix_jobs):
-                        self._fail_prefix_job(job)
+                    self._fail_prefill_round(str(e))
 
-            if self._spec_cooldown > 0:
-                # demoted after sustained all-miss steps: count pipelined
-                # steps down to the next spec re-probe
-                self._spec_cooldown -= 1
             if (
                 self.decoding and self.spec_k > 0
                 and self._spec_cooldown == 0 and self._spec_candidates()

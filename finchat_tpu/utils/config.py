@@ -227,6 +227,22 @@ class EngineConfig:
     # retrieved block when it arrives; falls back to the serial path
     # whenever the graft would invalidate already-prefilled KV
     retrieval_overlap: bool = True
+    # unified mixed prefill+decode step (engine mixed_step): one ragged
+    # [rows, chunk] device dispatch per scheduler iteration advances every
+    # prefilling row one chunk AND every decoding row one token (decode
+    # rows are length-1 rows of the same batch), instead of a serialized
+    # prefill round plus a decode step — the admission-stall a long prompt
+    # adds to every in-flight stream's inter-token latency shrinks to the
+    # fused step's own time. Default on for the chunked path; the split
+    # path remains the golden-identical fallback and takes over whenever
+    # spec decode, decode_loop blocks, grammar-constrained picks, or
+    # ring/seq-sharded prefill are active.
+    mixed_step: bool = True
+    # persistent XLA compilation cache directory
+    # (jax_compilation_cache_dir): warmup's compiles land on disk and a
+    # restarted process reloads them instead of re-paying full XLA
+    # compilation; "" = off (JAX default behavior)
+    compilation_cache_dir: str = ""
     # chunked ring prefill: segment size (tokens) for the seq-sharded
     # prefill. > 0 splits a ring-eligible prompt into segments that
     # interleave with decode steps in the scheduler loop (each segment
@@ -354,6 +370,10 @@ def load_config(
     )
     cfg.engine.retrieval_overlap = _env_bool(
         "FINCHAT_RETRIEVAL_OVERLAP", cfg.engine.retrieval_overlap
+    )
+    cfg.engine.mixed_step = _env_bool("FINCHAT_MIXED_STEP", cfg.engine.mixed_step)
+    cfg.engine.compilation_cache_dir = _env(
+        "FINCHAT_COMPILATION_CACHE_DIR", cfg.engine.compilation_cache_dir
     )
     cfg.serve.port = _env_int("FINCHAT_PORT", cfg.serve.port)
 

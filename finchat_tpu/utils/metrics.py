@@ -23,6 +23,19 @@ resume), ``finchat_session_cache_offloaded_pages_total``,
 the ``finchat_session_offload_seconds`` / ``finchat_session_restore_seconds``
 histograms (D2H snapshot / H2D resume latency).
 
+Mixed-step family (engine mixed_step, scheduler mixed path):
+``finchat_mixed_dispatches_total`` (unified prefill+decode dispatches — one
+per scheduler iteration on the mixed path), ``finchat_mixed_step_seconds``
+(host-side dispatch+fetch time per mixed round),
+``finchat_coexist_iterations_total`` (scheduler iterations where prefill
+work and in-flight decodes coexist — the denominator for the
+dispatches-per-iteration figure bench.py --mixed-sweep reports; the split
+path pays ~2 model dispatches per such iteration, the mixed path 1), and
+``finchat_inter_token_seconds`` — a histogram of per-sequence inter-token
+gaps LABELED by ``prefill_concurrent`` ("yes" when the emitting iteration
+also ran prefill work, "no" for steady decode), the instrument that makes
+the mixed step's admission-stall win visible in Prometheus.
+
 Retrieval-plane family (embed/batcher.py microbatcher, embed/index.py
 batched search, agent/scheduler overlap):
 ``finchat_embed_batch_occupancy`` (gauge — texts in the last coalesced
@@ -49,6 +62,21 @@ import threading
 import time
 from collections import defaultdict
 from dataclasses import dataclass, field
+
+
+def _labeled_key(name: str, labels: dict[str, str] | None) -> str:
+    """Internal series key: ``name`` or ``name{k="v",...}`` (labels sorted)
+    — one histogram per label combination, Prometheus-style."""
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return f"{name}{{{inner}}}"
+
+
+def _split_key(key: str) -> tuple[str, str]:
+    """Inverse of _labeled_key: (base name, label string without braces)."""
+    base, _, rest = key.partition("{")
+    return base, rest[:-1] if rest else ""
 
 
 @dataclass
@@ -105,11 +133,13 @@ class MetricsRegistry:
         with self._lock:
             self._gauges[name] = value
 
-    def observe(self, name: str, value: float) -> None:
+    def observe(self, name: str, value: float,
+                labels: dict[str, str] | None = None) -> None:
+        key = _labeled_key(name, labels)
         with self._lock:
-            if name not in self._histograms:
-                self._histograms[name] = _Histogram()
-            self._histograms[name].observe(value)
+            if key not in self._histograms:
+                self._histograms[key] = _Histogram()
+            self._histograms[key].observe(value)
 
     def get(self, name: str) -> float:
         with self._lock:
@@ -117,9 +147,10 @@ class MetricsRegistry:
                 return self._counters[name]
             return self._gauges.get(name, 0.0)
 
-    def quantile(self, name: str, q: float) -> float:
+    def quantile(self, name: str, q: float,
+                 labels: dict[str, str] | None = None) -> float:
         with self._lock:
-            hist = self._histograms.get(name)
+            hist = self._histograms.get(_labeled_key(name, labels))
             return hist.quantile(q) if hist else 0.0
 
     def snapshot(self) -> dict[str, float]:
@@ -143,16 +174,32 @@ class MetricsRegistry:
             for name, value in sorted(self._gauges.items()):
                 lines.append(f"# TYPE {name} gauge")
                 lines.append(f"{name} {value}")
-            for name, h in sorted(self._histograms.items()):
-                lines.append(f"# TYPE {name} histogram")
+            # group label variants of one histogram under a single TYPE
+            # line (Prometheus text format wants a metric's series
+            # consecutive); labeled bucket lines merge the series labels
+            # with the le= edge
+            seen_types: set[str] = set()
+            for key in sorted(self._histograms, key=_split_key):
+                base, lbl = _split_key(key)
+                h = self._histograms[key]
+                if base not in seen_types:
+                    seen_types.add(base)
+                    lines.append(f"# TYPE {base} histogram")
+
+                def series(extra: str = "") -> str:
+                    both = ",".join(x for x in (lbl, extra) if x)
+                    return "{" + both + "}" if both else ""
+
                 cumulative = 0
                 for i, edge in enumerate(h.buckets):
                     cumulative += h.counts[i]
-                    lines.append(f'{name}_bucket{{le="{edge}"}} {cumulative}')
+                    le = 'le="%s"' % edge
+                    lines.append(f"{base}_bucket{series(le)} {cumulative}")
                 cumulative += h.counts[-1]
-                lines.append(f'{name}_bucket{{le="+Inf"}} {cumulative}')
-                lines.append(f"{name}_sum {h.total}")
-                lines.append(f"{name}_count {h.n}")
+                le_inf = 'le="+Inf"'
+                lines.append(f"{base}_bucket{series(le_inf)} {cumulative}")
+                lines.append(f"{base}_sum{series()} {h.total}")
+                lines.append(f"{base}_count{series()} {h.n}")
         return "\n".join(lines) + "\n"
 
 

@@ -1,0 +1,472 @@
+"""Unified mixed prefill+decode step (engine mixed_step + scheduler mixed
+path, ISSUE 4).
+
+The contract under test: the mixed path is pure dispatch fusion — greedy
+streams are byte-identical to the split path (prefill round + decode step),
+including a prompt completing mid-batch and a grammar-constrained slot
+forcing demotion; decode slots advance a token in EVERY mixed round while a
+long prompt prefills (admission fairness); allocator/page-table invariants
+hold after mixed rounds; and a whole-round prefill failure no longer evicts
+parked overlap holds that were not in the failed dispatch (regression)."""
+
+import asyncio
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from finchat_tpu.engine.engine import (
+    InferenceEngine,
+    commit_first_token,
+    decode_step,
+    mixed_step,
+    prefill_step,
+)
+from finchat_tpu.engine.kv_cache import PageAllocator, pages_needed
+from finchat_tpu.engine.sampler import SamplingParams
+from finchat_tpu.engine.scheduler import ContinuousBatchingScheduler
+from finchat_tpu.models.llama import PRESETS, init_params
+from finchat_tpu.models.tokenizer import ByteTokenizer
+from finchat_tpu.utils.config import EngineConfig
+from finchat_tpu.utils.metrics import METRICS
+
+# fp32: a decode row computes at the ragged [rows, chunk] shape in mixed
+# mode vs [max_seqs, 1] in split mode, and under bf16 a last-ulp KV
+# difference can flip a LATER near-tie argmax (the chunk-width caveat
+# verify_step documents). fp32 pins the byte-identity contract so a
+# structural bug cannot hide behind — or be excused by — rounding.
+import dataclasses
+
+CONFIG = dataclasses.replace(PRESETS["tiny"], dtype=jnp.float32)
+CHUNK = 16
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CONFIG, jax.random.key(0))
+
+
+def _stack(params, mixed=True, max_seqs=4, num_pages=128, eos_id=-1):
+    cfg = EngineConfig(
+        max_seqs=max_seqs, page_size=8, num_pages=num_pages, max_seq_len=128,
+        prefill_chunk=CHUNK, mixed_step=mixed, session_cache=False,
+    )
+    engine = InferenceEngine(CONFIG, params, cfg)
+    return ContinuousBatchingScheduler(engine, eos_id=eos_id)
+
+
+async def _drain(handle, out):
+    while True:
+        ev = await asyncio.wait_for(handle.events.get(), timeout=120)
+        if ev["type"] == "token":
+            out.append(ev["token_id"])
+        elif ev["type"] == "done":
+            assert handle.events.empty()
+            return
+        else:
+            raise AssertionError(ev)
+
+
+# --- engine level -----------------------------------------------------------
+
+
+def test_engine_mixed_step_matches_split_math(params):
+    """One mixed dispatch == one prefill chunk + one decode step + one
+    commit, exactly: the decode row's greedy token, the completing prefill
+    row's greedy first token, and the resulting context_lens all match the
+    split dispatches from an identically prepared engine."""
+
+    def prepare():
+        cfg = EngineConfig(
+            max_seqs=4, page_size=8, num_pages=64, max_seq_len=128,
+            prefill_chunk=CHUNK,
+        )
+        eng = InferenceEngine(CONFIG, params, cfg)
+        alloc = PageAllocator(cfg.num_pages)
+        # slot 0: fully prefilled + committed → decoding
+        p0 = [3, 7, 11, 200, 42]
+        pages0 = alloc.allocate("s0", pages_needed(len(p0) + 8, eng.page_size))
+        eng.set_page_table_row(0, pages0)
+        logits = eng.prefill(0, p0)
+        eng.state, tok0 = commit_first_token(
+            eng.state, jnp.int32(0), logits,
+            jnp.float32(0.0), jnp.float32(1.0), jnp.int32(0),
+        )
+        # slot 1: a 2-chunk prompt with only the FIRST chunk prefilled
+        p1 = list(range(1, CHUNK + 6))
+        pages1 = alloc.allocate("s1", pages_needed(len(p1) + 8, eng.page_size))
+        eng.set_page_table_row(1, pages1)
+        c1 = p1[:CHUNK]
+        eng.state, _ = prefill_step(
+            eng.params, eng.state,
+            jnp.asarray([c1], jnp.int32), jnp.asarray([1], jnp.int32),
+            jnp.asarray([0], jnp.int32), jnp.asarray([len(c1)], jnp.int32),
+            config=eng.config, page_size=eng.page_size,
+            attn_backend=eng.attn_backend,
+        )
+        return eng, p1, int(tok0)
+
+    # --- split: finish slot 1's prefill, commit, then one decode step ----
+    eng_s, p1, _ = prepare()
+    tail = p1[CHUNK:]
+    eng_s.state, logits = prefill_step(
+        eng_s.params, eng_s.state,
+        jnp.asarray([tail + [0] * (CHUNK - len(tail))], jnp.int32),
+        jnp.asarray([1], jnp.int32), jnp.asarray([CHUNK], jnp.int32),
+        jnp.asarray([len(tail)], jnp.int32),
+        config=eng_s.config, page_size=eng_s.page_size,
+        attn_backend=eng_s.attn_backend,
+    )
+    eng_s.state, first1 = commit_first_token(
+        eng_s.state, jnp.int32(1), logits[0],
+        jnp.float32(0.0), jnp.float32(1.0), jnp.int32(0),
+    )
+    B = eng_s.engine_cfg.max_seqs
+    active = jnp.zeros((B,), bool).at[0].set(True)
+    tok_dec = eng_s.decode(
+        active, jnp.zeros((B,)), jnp.ones((B,)), jnp.zeros((B,), jnp.int32)
+    )
+    split = (int(tok_dec[0]), int(first1),
+             np.asarray(eng_s.state.context_lens)[:2].tolist())
+
+    # --- mixed: both advances in ONE ragged dispatch ---------------------
+    eng_m, p1, _ = prepare()
+    tokens = np.zeros((2, CHUNK), np.int32)
+    tokens[0, : len(tail)] = tail  # row 0: slot 1's completing chunk
+    eng_m.state, next_tokens, _ = mixed_step(
+        eng_m.params, eng_m.state,
+        jnp.asarray(tokens),
+        jnp.asarray([1, 0], jnp.int32),          # slots
+        jnp.asarray([CHUNK, 0], jnp.int32),      # start (decode row overridden)
+        jnp.asarray([len(tail), 1], jnp.int32),  # n_valid
+        jnp.asarray([False, True]),              # is_decode
+        jnp.asarray([True, True]),               # arm (completion + decode)
+        jnp.zeros((2,), jnp.float32), jnp.ones((2,), jnp.float32),
+        jnp.zeros((2,), jnp.int32),
+        config=eng_m.config, page_size=eng_m.page_size,
+        attn_backend=eng_m.attn_backend,
+    )
+    got = (int(next_tokens[1]), int(next_tokens[0]),
+           np.asarray(eng_m.state.context_lens)[:2].tolist())
+    assert got == split
+    # both slots' next decode inputs are armed identically
+    assert (np.asarray(eng_m.state.last_tokens)[:2]
+            == np.asarray(eng_s.state.last_tokens)[:2]).all()
+
+
+# --- scheduler level: byte-identity -----------------------------------------
+
+
+def _run_workload(params, mixed, with_constraint=False):
+    """Two decode streams, then a long prompt admitted mid-decode (so its
+    chunks coexist with live decodes), plus optionally a grammar-constrained
+    stream. Returns (streams dict, mixed dispatch count)."""
+    sched = _stack(params, mixed=mixed)
+    tok = ByteTokenizer()
+    rng = np.random.default_rng(7)
+    short_a = rng.integers(1, CONFIG.vocab_size, size=10).tolist()
+    short_b = rng.integers(1, CONFIG.vocab_size, size=14).tolist()
+    # 5 full chunks + a 2-token tail: the final mixed round fits the SMALL
+    # chunk bucket (mixed_chunk_buckets → CHUNK//8 = 2), so identity
+    # covers both compiled column widths
+    long_p = rng.integers(1, CONFIG.vocab_size, size=5 * CHUNK + 2).tolist()
+
+    async def go():
+        d0 = METRICS.get("finchat_mixed_dispatches_total")
+        await sched.start()
+        try:
+            ha = await sched.submit(
+                "a", short_a, SamplingParams(temperature=0.0, max_new_tokens=28))
+            hb = await sched.submit(
+                "b", short_b, SamplingParams(temperature=0.0, max_new_tokens=22))
+            outs = {"a": [], "b": [], "long": []}
+            tasks = [asyncio.create_task(_drain(ha, outs["a"])),
+                     asyncio.create_task(_drain(hb, outs["b"]))]
+            if with_constraint:
+                from finchat_tpu.agent.constrained import GrammarVocab, TokenConstraint
+
+                hc = await sched.submit(
+                    "tool", tok.encode("decide", add_bos=True),
+                    SamplingParams(temperature=0.0, max_new_tokens=20),
+                    constraint=TokenConstraint(GrammarVocab.for_tokenizer(tok)),
+                )
+                outs["tool"] = []
+                tasks.append(asyncio.create_task(_drain(hc, outs["tool"])))
+            while len(outs["a"]) < 2 or len(outs["b"]) < 2:
+                await asyncio.sleep(0.002)
+            hl = await sched.submit(
+                "long", long_p, SamplingParams(temperature=0.0, max_new_tokens=6))
+            tasks.append(asyncio.create_task(_drain(hl, outs["long"])))
+            await asyncio.gather(*tasks)
+            sched.allocator.check_invariants()
+            assert sched.allocator.used_count == 0
+            assert sorted(sched.free_slots) == list(range(4))
+            return outs, METRICS.get("finchat_mixed_dispatches_total") - d0
+        finally:
+            await sched.stop()
+
+    return asyncio.run(go())
+
+
+def test_mixed_vs_split_streams_identical(params):
+    """Greedy streams — two in-flight decodes, a long prompt admitted
+    mid-decode, and the long prompt completing mid-batch — are
+    byte-identical mixed vs split, and the mixed run actually fused."""
+    split, n_split = _run_workload(params, mixed=False)
+    mixed, n_mixed = _run_workload(params, mixed=True)
+    assert [len(s) for s in split.values()] == [28, 22, 6]
+    assert mixed == split
+    assert n_split == 0
+    # the long prompt spans 5 chunks; each coexisted with live decodes
+    assert n_mixed >= 5
+
+
+def _constrained_workload(params, mixed, recorded=None):
+    """A bystander decode, a grammar-constrained stream, a long prompt
+    admitted while the constrained stream is live (phase 1 — every
+    iteration must demote to split), then a second long prompt admitted
+    after the constrained stream retires (phase 2 — fusion must resume).
+    ``recorded`` (mixed runs) collects, per mixed dispatch, whether any
+    constrained handle was live."""
+    sched = _stack(params, mixed=mixed)
+    if recorded is not None:
+        real_mixed = sched.engine.mixed
+
+        def spy(*args, **kwargs):
+            live = list(sched.decoding.values()) + list(sched.prefilling)
+            recorded.append(any(h.constraint is not None for h in live))
+            return real_mixed(*args, **kwargs)
+
+        sched.engine.mixed = spy
+    tok = ByteTokenizer()
+    rng = np.random.default_rng(7)
+    by_prompt = rng.integers(1, CONFIG.vocab_size, size=10).tolist()
+    long1 = rng.integers(1, CONFIG.vocab_size, size=3 * CHUNK).tolist()
+    long2 = rng.integers(1, CONFIG.vocab_size, size=3 * CHUNK).tolist()
+
+    async def go():
+        from finchat_tpu.agent.constrained import GrammarVocab, TokenConstraint
+
+        await sched.start()
+        try:
+            outs = {"by": [], "tool": [], "long1": [], "long2": []}
+            hb = await sched.submit(
+                "by", by_prompt, SamplingParams(temperature=0.0, max_new_tokens=80))
+            tasks = [asyncio.create_task(_drain(hb, outs["by"]))]
+            hc = await sched.submit(
+                "tool", tok.encode("decide", add_bos=True),
+                SamplingParams(temperature=0.0, max_new_tokens=12),
+                constraint=TokenConstraint(GrammarVocab.for_tokenizer(tok)),
+            )
+            tool_task = asyncio.create_task(_drain(hc, outs["tool"]))
+            tasks.append(tool_task)
+            while len(outs["by"]) < 2:
+                await asyncio.sleep(0.002)
+            hl1 = await sched.submit(
+                "long1", long1, SamplingParams(temperature=0.0, max_new_tokens=4))
+            tasks.append(asyncio.create_task(_drain(hl1, outs["long1"])))
+            await tool_task  # constrained stream retires
+            hl2 = await sched.submit(
+                "long2", long2, SamplingParams(temperature=0.0, max_new_tokens=4))
+            tasks.append(asyncio.create_task(_drain(hl2, outs["long2"])))
+            await asyncio.gather(*tasks)
+            return outs
+        finally:
+            await sched.stop()
+
+    return asyncio.run(go())
+
+
+def test_constrained_slot_forces_demotion_and_identity(params):
+    """A grammar-constrained slot demotes every iteration it is in flight
+    to the split path (its host-side pick cannot ride a fused dispatch):
+    no mixed dispatch ever sees it live, fusion resumes once it retires,
+    and the whole workload's greedy streams stay byte-identical mixed vs
+    split."""
+    split = _constrained_workload(params, mixed=False)
+    recorded: list[bool] = []
+    mixed = _constrained_workload(params, mixed=True, recorded=recorded)
+    assert mixed == split
+    assert not any(recorded), "a mixed dispatch ran with a constrained slot live"
+    # phase 2 (constrained stream retired, long2 prefilling beside the
+    # bystander) must have fused at least long2's chunk count
+    assert len(recorded) >= 3, "mixed fusion never resumed after demotion"
+
+
+# --- scheduler level: admission fairness ------------------------------------
+
+
+def test_admission_fairness_decode_advances_every_mixed_round(params):
+    """While a long prompt prefills, every mixed dispatch carries ALL live
+    decoding slots as decode rows — decode streams advance one token per
+    scheduler iteration instead of stalling behind a serialized prefill
+    round. Each mixed call must contain a prefill row AND exactly the
+    decoding population as length-1 rows."""
+    sched = _stack(params, mixed=True)
+    calls: list[tuple[int, int, int]] = []  # (#prefill rows, #decode rows, #decoding)
+    real_mixed = sched.engine.mixed
+
+    def spy(tokens, slots, start_pos, n_valid, is_decode, arm, *rest):
+        nv = np.asarray(n_valid)
+        dec = np.asarray(is_decode)
+        calls.append((
+            int(((nv > 0) & ~dec).sum()), int(dec.sum()), len(sched.decoding),
+        ))
+        return real_mixed(tokens, slots, start_pos, n_valid, is_decode, arm, *rest)
+
+    sched.engine.mixed = spy
+    rng = np.random.default_rng(3)
+    short = rng.integers(1, CONFIG.vocab_size, size=9).tolist()
+    long_p = rng.integers(1, CONFIG.vocab_size, size=6 * CHUNK).tolist()
+
+    async def go():
+        await sched.start()
+        try:
+            h1 = await sched.submit(
+                "d1", short, SamplingParams(temperature=0.0, max_new_tokens=40))
+            h2 = await sched.submit(
+                "d2", short[:5], SamplingParams(temperature=0.0, max_new_tokens=36))
+            o1, o2 = [], []
+            t1 = asyncio.create_task(_drain(h1, o1))
+            t2 = asyncio.create_task(_drain(h2, o2))
+            while len(o1) < 2 or len(o2) < 2:
+                await asyncio.sleep(0.002)
+            hl = await sched.submit(
+                "long", long_p, SamplingParams(temperature=0.0, max_new_tokens=4))
+            ol = []
+            tl = asyncio.create_task(_drain(hl, ol))
+            await asyncio.gather(t1, t2, tl)
+            return o1, o2, ol
+        finally:
+            await sched.stop()
+
+    o1, o2, ol = asyncio.run(go())
+    assert (len(o1), len(o2), len(ol)) == (40, 36, 4)
+    assert len(calls) >= 6  # one mixed round per long-prompt chunk, minimum
+    for n_prefill, n_decode, n_decoding in calls:
+        assert n_prefill >= 1, "a mixed dispatch carried no prefill row"
+        assert n_decode == n_decoding, (
+            "a decoding slot sat out a mixed dispatch", calls)
+        assert n_decode >= 1
+
+
+# --- scheduler level: invariants under churn --------------------------------
+
+
+def test_allocator_and_slot_invariants_after_mixed_waves(params):
+    """Wave-loaded mixed rounds (pool smaller than offered load, staggered
+    budgets, admissions landing while others decode) leave the allocator
+    and slot bookkeeping clean."""
+    tok = ByteTokenizer()
+    sched = _stack(params, mixed=True, max_seqs=3, num_pages=32)
+
+    async def go():
+        await sched.start()
+        try:
+            handles = [
+                await sched.submit(
+                    f"w{i}", tok.encode(f"wave prompt number {i}", add_bos=True),
+                    SamplingParams(temperature=0.0, max_new_tokens=8 + 4 * i),
+                )
+                for i in range(6)
+            ]
+            outs = [[] for _ in handles]
+            await asyncio.gather(*[
+                _drain(h, o) for h, o in zip(handles, outs)
+            ])
+            return [len(o) for o in outs]
+        finally:
+            await sched.stop()
+
+    counts = asyncio.run(go())
+    assert counts == [8 + 4 * i for i in range(6)], counts
+    sched.allocator.check_invariants()
+    assert sched.allocator.used_count == 0
+    assert sorted(sched.free_slots) == list(range(3))
+    assert not sched.prefilling and not sched.decoding
+    assert np.asarray(sched.engine.state.context_lens).sum() == 0
+    assert np.asarray(sched.engine.state.page_table).sum() == 0
+
+
+def test_inter_token_histogram_labeled_by_prefill_coexistence(params):
+    """The finchat_inter_token_seconds histogram distinguishes tokens
+    emitted while prefill work ran (admission) from steady decode — both
+    series must be populated by a coexistence workload."""
+    y0 = METRICS.quantile("finchat_inter_token_seconds", 0.5,
+                          labels={"prefill_concurrent": "yes"})
+    before_yes = METRICS.snapshot().get(
+        'finchat_inter_token_seconds{prefill_concurrent="yes"}_count', 0)
+    before_no = METRICS.snapshot().get(
+        'finchat_inter_token_seconds{prefill_concurrent="no"}_count', 0)
+    _run_workload(params, mixed=True)
+    snap = METRICS.snapshot()
+    assert snap['finchat_inter_token_seconds{prefill_concurrent="yes"}_count'] > before_yes
+    assert snap['finchat_inter_token_seconds{prefill_concurrent="no"}_count'] > before_no
+    assert y0 >= 0.0  # quantile path accepts labels
+
+
+# --- regression: whole-round failure must spare parked holds ----------------
+
+
+def test_prefill_round_failure_spares_parked_holds(params, monkeypatch):
+    """A whole-round prefill failure fails only the sequences IN the
+    dispatch: a parked overlap hold (prefix complete, awaiting
+    extend_prompt) was skipped from the round and must survive it, then
+    complete normally after its graft. The pre-fix handler evicted
+    everything in self.prefilling, killing in-flight retrieval overlaps
+    that never touched the failed dispatch."""
+    import finchat_tpu.engine.scheduler as sched_mod
+
+    sched = _stack(params, mixed=False)
+    rng = np.random.default_rng(11)
+    prefix = rng.integers(1, CONFIG.vocab_size, size=40).tolist()
+    full = prefix + rng.integers(1, CONFIG.vocab_size, size=12).tolist()
+    samp = SamplingParams(temperature=0.0, max_new_tokens=5)
+
+    real = sched_mod.prefill_step
+    state = {"armed": False, "fired": False}
+
+    def flaky(*args, **kwargs):
+        if state["armed"]:
+            state["armed"] = False
+            state["fired"] = True
+            raise RuntimeError("injected whole-round failure")
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(sched_mod, "prefill_step", flaky)
+
+    async def go():
+        await sched.start()
+        try:
+            hold = await sched.submit_partial("hold", prefix, samp)
+            assert hold is not None
+            t0 = time.perf_counter()
+            while hold.prefill_pos < len(hold.prompt_ids):
+                assert time.perf_counter() - t0 < 60
+                await asyncio.sleep(0.01)
+            assert hold.held and hold in sched.prefilling
+
+            # now fail the NEXT whole round (the victim's dispatch)
+            state["armed"] = True
+            victim = await sched.submit("victim", full[:20], samp)
+            ev = await asyncio.wait_for(victim.events.get(), timeout=60)
+            assert ev["type"] == "error" and "injected" in ev["message"]
+            assert state["fired"]
+
+            # the parked hold survived the failed round...
+            assert not hold.finished and hold in sched.prefilling and hold.held
+
+            # ...and still completes after its graft
+            assert sched.extend_prompt(hold, full)
+            tokens = []
+            await _drain(hold, tokens)
+            return tokens
+        finally:
+            await sched.stop()
+
+    tokens = asyncio.run(go())
+    assert len(tokens) == 5
+    sched.allocator.check_invariants()
+    assert sched.allocator.used_count == 0

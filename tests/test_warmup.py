@@ -15,6 +15,7 @@ from finchat_tpu.engine.engine import (
     commit_first_token,
     decode_loop_step,
     decode_step,
+    mixed_step,
     prefill_step,
     verify_step,
 )
@@ -118,6 +119,32 @@ def test_warmup_covers_decode_loop_variant():
     # state-neutrality of the warmup block itself is covered by
     # test_warmup_is_state_neutral running depth 1; check the depth>1 path
     eng2 = _tiny_engine(decode_loop_depth=4)
+    eng2.warmup()
+    assert np.asarray(eng2.state.context_lens).tolist() == [0, 0]
+    assert np.asarray(eng2.state.page_table).sum() == 0
+
+
+def test_warmup_covers_mixed_step_variants():
+    """With mixed_step on (the default) every pow-2 row bucket of the
+    scheduler's unified prefill+decode dispatch must be compiled at
+    startup — the first admission-during-decode must not compile."""
+    eng = _tiny_engine()
+    eng.warmup()
+    before = mixed_step._cache_size()
+    assert before > 0, "warmup compiled no mixed variants"
+
+    for C in eng.mixed_chunk_buckets():  # full chunk + the short-tail width
+        for n in (1, 2):  # every row bucket the 2-slot engine can dispatch
+            zeros = jnp.zeros((n,), jnp.int32)
+            flags = jnp.zeros((n,), bool)
+            eng.mixed(
+                jnp.zeros((n, C), jnp.int32), zeros, zeros, zeros, flags, flags,
+                jnp.zeros((n,), jnp.float32), jnp.ones((n,), jnp.float32),
+                jnp.zeros((n,), jnp.int32),
+            )
+    assert mixed_step._cache_size() == before, "first mixed dispatch recompiled"
+    # state-neutrality with the mixed variants included
+    eng2 = _tiny_engine()
     eng2.warmup()
     assert np.asarray(eng2.state.context_lens).tolist() == [0, 0]
     assert np.asarray(eng2.state.page_table).sum() == 0
