@@ -119,6 +119,22 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mixed-smoke", action="store_true",
                    help="tiny --mixed-sweep variant for CI: fewer "
                         "episodes, fusion+identity gates only")
+    p.add_argument("--chaos-sweep", action="store_true",
+                   help="CPU-runnable chaos benchmark of the resilience "
+                        "plane (ISSUE 5): greedy streams under injected "
+                        "dispatch faults — breaker trip + engine rebuild "
+                        "with byte-identical survivors, page-pressure "
+                        "recompute preemption with zero failed streams, "
+                        "and a fault-rate sweep reporting goodput, "
+                        "rebuilds, preemptions, and recovery latency")
+    p.add_argument("--chaos-smoke", action="store_true",
+                   help="tiny --chaos-sweep variant for CI: the two "
+                        "acceptance gates only (streams survive a rebuild "
+                        "byte-identically; preempt/replay byte-identity "
+                        "with zero failed streams)")
+    p.add_argument("--chaos-rates", default="0.05,0.2",
+                   help="comma-separated decode-fault probabilities for "
+                        "the --chaos-sweep rate section")
     p.add_argument("--tpu-timeout", type=float, default=180.0,
                    help="seconds allowed for TPU backend INIT before the "
                         "child is declared hung (measurement gets "
@@ -170,7 +186,12 @@ def run_worker(args: argparse.Namespace) -> int:
     faulthandler.dump_traceback_later(max(60.0, args.measure_budget - 10.0), exit=True)
 
     work = resolve_workload(args, "tpu" if platform == "tpu" else "cpu")
-    if args.mixed_sweep:
+    if args.chaos_sweep or args.chaos_smoke:
+        result = measure_chaos_sweep(
+            smoke=args.chaos_smoke,
+            rates=tuple(float(r) for r in args.chaos_rates.split(",")),
+        )
+    elif args.mixed_sweep:
         result = measure_mixed_sweep(smoke=args.mixed_smoke)
     elif args.retrieval_sweep:
         result = measure_retrieval_sweep(
@@ -1146,6 +1167,216 @@ def measure_mixed_sweep(smoke: bool = False) -> dict:
     }
 
 
+def measure_chaos_sweep(smoke: bool = False, rates: tuple = (0.05, 0.2)) -> dict:
+    """Chaos benchmark of the resilience plane (ISSUE 5), CPU-runnable
+    through the REAL scheduler on the tiny fp32 config (fp32 pins greedy
+    byte-identity across the recompute-replay shapes).
+
+    Section A — breaker: greedy streams decode while ``breaker_threshold``
+    consecutive decode rounds are failed (utils.faults n_shot). The breaker
+    must trip, the engine device state rebuild, and EVERY stream complete
+    byte-identical to a fault-free run. Reports the rebuild count and the
+    trip→recovery latency.
+
+    Section B — page-pressure preemption: a deadline-less hog holds most of
+    a deliberately small KV pool; an earlier-deadline request arrives at
+    queue depth > free capacity. The hog must be recompute-preempted (not
+    the candidate head-of-line-stalled), BOTH streams must complete, and
+    the hog's replayed greedy stream must be byte-identical to an
+    uncontended run — zero failed streams under nonzero preemptions.
+
+    Section C (full sweep only) — fault-rate goodput: N requests per
+    injected decode-fault probability; reports goodput (completed/
+    submitted), wall time, preemptions, rebuilds, and sheds per rate.
+    Under the preempt/replay discipline goodput should hold at 1.0 for
+    moderate rates — faults cost re-prefills, not streams.
+    """
+    import asyncio
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from finchat_tpu.engine.engine import InferenceEngine
+    from finchat_tpu.engine.sampler import SamplingParams
+    from finchat_tpu.engine.scheduler import ContinuousBatchingScheduler
+    from finchat_tpu.models.llama import PRESETS, init_params
+    from finchat_tpu.utils import faults
+    from finchat_tpu.utils.config import EngineConfig
+    from finchat_tpu.utils.metrics import METRICS
+
+    config = dataclasses.replace(PRESETS["tiny"], dtype=jnp.float32)
+    params = init_params(config, jax.random.key(0))
+
+    def make_scheduler(**over):
+        cfg = dict(max_seqs=3, page_size=8, num_pages=96, max_seq_len=128,
+                   prefill_chunk=16, session_cache=False)
+        cfg.update(over)
+        engine = InferenceEngine(config, params, EngineConfig(**cfg))
+        return ContinuousBatchingScheduler(engine, eos_id=-1)
+
+    async def drain(handle):
+        tokens = []
+        while True:
+            ev = await handle.events.get()
+            if ev["type"] == "token":
+                tokens.append(ev["token_id"])
+            elif ev["type"] == "done":
+                return tokens, None
+            else:
+                return tokens, ev
+
+    greedy = lambda n: SamplingParams(temperature=0.0, max_new_tokens=n)  # noqa: E731
+    prompts = [list(range(1, 14)), list(range(20, 38)), list(range(50, 61))]
+
+    # ---- section A: breaker trip + rebuild, streams survive -------------
+    def run_breaker(fault: bool):
+        async def go():
+            sched = make_scheduler()
+            await sched.start()
+            try:
+                handles = [await sched.submit(f"s{i}", p, greedy(10))
+                           for i, p in enumerate(prompts)]
+                tasks = [asyncio.create_task(drain(h)) for h in handles]
+                if fault:
+                    while any(h.generated < 2 for h in handles):
+                        await asyncio.sleep(0.002)
+                    faults.arm("scheduler.decode",
+                               faults.n_shot(sched.breaker_threshold,
+                                             RuntimeError("chaos: wedged dispatch")))
+                results = [await asyncio.wait_for(t, timeout=300) for t in tasks]
+                sched.allocator.check_invariants()
+            finally:
+                await sched.stop()
+                faults.disarm_all()
+            return results
+
+        return asyncio.run(go())
+
+    r0 = METRICS.get("finchat_engine_rebuilds_total")
+    clean = run_breaker(False)
+    t_fault = time.perf_counter()
+    survived = run_breaker(True)
+    breaker_wall_s = time.perf_counter() - t_fault
+    rebuilds = int(METRICS.get("finchat_engine_rebuilds_total") - r0)
+    streams_survive = all(err is None for _, err in survived)
+    rebuild_identical = [t for t, _ in survived] == [t for t, _ in clean]
+    recovery_p50_ms = round(
+        1000 * METRICS.quantile("finchat_breaker_recovery_seconds", 0.5), 1
+    )
+    print(f"[bench] chaos breaker: rebuilds={rebuilds} survived={streams_survive} "
+          f"identical={rebuild_identical} recovery_p50={recovery_p50_ms}ms",
+          file=sys.stderr, flush=True)
+
+    # ---- section B: page-pressure preemption, zero failed streams -------
+    def run_pressure(contended: bool):
+        async def go():
+            # 7 usable pages; the hog takes 6, the urgent needs 3
+            sched = make_scheduler(max_seqs=2, num_pages=8)
+            await sched.start()
+            try:
+                hog = await sched.submit("hog", list(range(1, 24)), greedy(24))
+                hog_task = asyncio.create_task(drain(hog))
+                urgent_result = (None, None)
+                if contended:
+                    while hog.generated < 3:
+                        await asyncio.sleep(0.002)
+                    urgent = await sched.submit(
+                        "urgent", list(range(40, 56)), greedy(8),
+                        deadline=time.perf_counter() + 120.0,
+                    )
+                    urgent_result = await asyncio.wait_for(
+                        asyncio.ensure_future(drain(urgent)), timeout=300
+                    )
+                hog_result = await asyncio.wait_for(hog_task, timeout=300)
+                sched.allocator.check_invariants()
+            finally:
+                await sched.stop()
+            return hog_result, urgent_result
+
+        return asyncio.run(go())
+
+    p0 = METRICS.get("finchat_preemptions_total")
+    (clean_hog, _), _ = run_pressure(False)
+    (hog_tokens, hog_err), (urgent_tokens, urgent_err) = run_pressure(True)
+    preemptions = int(METRICS.get("finchat_preemptions_total") - p0)
+    preempt_zero_failed = hog_err is None and urgent_err is None
+    preempt_identical = hog_tokens == clean_hog
+    print(f"[bench] chaos preemption: preemptions={preemptions} "
+          f"zero_failed={preempt_zero_failed} identical={preempt_identical}",
+          file=sys.stderr, flush=True)
+
+    # ---- section C: fault-rate goodput sweep (full mode only) -----------
+    rate_rows = []
+    if not smoke:
+        n_req = 6
+        for rate in rates:
+            async def go(rate=rate):
+                sched = make_scheduler()
+                await sched.start()
+                try:
+                    faults.arm("scheduler.decode",
+                               faults.flaky(rate, RuntimeError("chaos flaky"), seed=7))
+                    handles = [
+                        await sched.submit(
+                            f"r{rate}-{i}", prompts[i % len(prompts)], greedy(10),
+                            deadline=time.perf_counter() + 600.0,
+                        )
+                        for i in range(n_req)
+                    ]
+                    return [
+                        await asyncio.wait_for(asyncio.ensure_future(drain(h)), timeout=300)
+                        for h in handles
+                    ]
+                finally:
+                    await sched.stop()
+                    faults.disarm_all()
+
+            s0 = METRICS.snapshot()
+            t0 = time.perf_counter()
+            results = asyncio.run(go())
+            wall = time.perf_counter() - t0
+            s1 = METRICS.snapshot()
+            completed = sum(1 for _, err in results if err is None)
+            rate_rows.append({
+                "fault_rate": rate,
+                "submitted": n_req,
+                "completed": completed,
+                "goodput": round(completed / n_req, 3),
+                "wall_s": round(wall, 2),
+                "preemptions": int(s1.get("finchat_preemptions_total", 0)
+                                   - s0.get("finchat_preemptions_total", 0)),
+                "rebuilds": int(s1.get("finchat_engine_rebuilds_total", 0)
+                                - s0.get("finchat_engine_rebuilds_total", 0)),
+                "sheds": int(s1.get("finchat_sheds_total", 0)
+                             - s0.get("finchat_sheds_total", 0)),
+            })
+            print(f"[bench] chaos rate {rate}: goodput "
+                  f"{rate_rows[-1]['goodput']} ({completed}/{n_req}), "
+                  f"preemptions {rate_rows[-1]['preemptions']}, "
+                  f"rebuilds {rate_rows[-1]['rebuilds']}",
+                  file=sys.stderr, flush=True)
+
+    return {
+        "metric": "chaos_sweep",
+        "unit": "goodput, rebuilds, preemptions",
+        "smoke": smoke,
+        "model": "tiny (fp32 — identity contract, see measure_chaos_sweep)",
+        # acceptance gates (tier1.yml --chaos-smoke)
+        "streams_survive_rebuild": streams_survive,
+        "rebuild_outputs_identical": rebuild_identical,
+        "engine_rebuilds": rebuilds,
+        "breaker_recovery_p50_ms": recovery_p50_ms,
+        "breaker_wall_s": round(breaker_wall_s, 2),
+        "preemptions": preemptions,
+        "preempt_zero_failed": preempt_zero_failed,
+        "preempt_outputs_identical": preempt_identical,
+        "rate_sweep": rate_rows,
+        "platform": jax.devices()[0].platform,
+        "device": str(jax.devices()[0]),
+    }
+
+
 # --------------------------------------------------------------------------
 # Orchestrator: jax-free; spawns workers, never hangs, always prints JSON.
 # --------------------------------------------------------------------------
@@ -1175,6 +1406,9 @@ def spawn_worker(args: argparse.Namespace, platform: str, timeout: float) -> dic
         cmd += ["--mixed-sweep"]
         if args.mixed_smoke:
             cmd += ["--mixed-smoke"]
+    if args.chaos_sweep or args.chaos_smoke:
+        cmd += ["--chaos-rates", args.chaos_rates]
+        cmd += ["--chaos-smoke"] if args.chaos_smoke else ["--chaos-sweep"]
     print(f"[bench] spawning {platform} worker (timeout {timeout:.0f}s)",
           file=sys.stderr, flush=True)
     try:

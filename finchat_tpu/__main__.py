@@ -40,6 +40,12 @@ def main() -> None:
                    help="host-RAM byte budget for the session KV cache "
                         "(engine/session_cache.py); 0 disables cross-turn "
                         "KV resume — also FINCHAT_SESSION_CACHE_BYTES")
+    p.add_argument("--request-deadline-seconds", type=float, default=None,
+                   help="per-request deadline (Kafka producer timestamp + "
+                        "this): past-deadline pending requests shed with a "
+                        "retryable error and admission goes earliest-"
+                        "deadline-first (ROBUSTNESS.md); 0 = off — also "
+                        "FINCHAT_REQUEST_DEADLINE_SECONDS")
     args = p.parse_args()
 
     overrides: dict = {}
@@ -51,6 +57,8 @@ def main() -> None:
         overrides["engine.decode_loop_depth"] = args.decode_loop_depth
     if args.session_cache_bytes is not None:
         overrides["engine.session_cache_bytes"] = args.session_cache_bytes
+    if args.request_deadline_seconds is not None:
+        overrides["engine.request_deadline_seconds"] = args.request_deadline_seconds
     cfg = load_config(args.config, overrides)
 
     from finchat_tpu.serve.app import build_app

@@ -266,6 +266,7 @@ class LLMAgent:
         decision_text = await self.tool_generator.generate(
             self._tool_prompt_text(state), self.tool_sampling,
             conversation_id=self._session_key(state, "tool"),
+            deadline=state.deadline,
         )
         tool_call = parse_tool_decision(decision_text)
         if tool_call is not None:
@@ -306,6 +307,7 @@ class LLMAgent:
                     state.partial_prefill = await self.response_generator.begin_partial(
                         self._response_prefix_text(state), self.response_sampling,
                         conversation_id=self._session_key(state, "resp"),
+                        deadline=state.deadline,
                     )
                 except Exception as e:  # overlap is an optimization, never fatal
                     logger.warning("partial prefill unavailable, serial path: %s", e)
@@ -358,6 +360,8 @@ class LLMAgent:
         passed when the overlap path actually took a hold — so generators
         without the seam (StubGenerator, test doubles) never see it."""
         kwargs: dict[str, Any] = {"conversation_id": self._session_key(state, "resp")}
+        if state.deadline is not None:
+            kwargs["deadline"] = state.deadline
         if state.partial_prefill is not None:
             kwargs["partial"] = state.partial_prefill
         return kwargs
@@ -397,6 +401,7 @@ class LLMAgent:
         user_context: str = "",
         chat_history: list[ChatMessage] | None = None,
         conversation_id: str | None = None,
+        deadline: float | None = None,
     ) -> dict[str, Any]:
         """Batch path through the compiled graph (reference llm_agent.py:175)."""
         logger.info("Processing query for user %s: %s", user_id, user_query)
@@ -407,6 +412,7 @@ class LLMAgent:
             user_context=user_context,
             chat_history=list(chat_history or []),
             tool_calls=deque(),
+            deadline=deadline,
         )
         try:
             final_state = await self.graph.ainvoke(state)
@@ -426,6 +432,7 @@ class LLMAgent:
         user_context: str = "",
         chat_history: list[ChatMessage] | None = None,
         conversation_id: str | None = None,
+        deadline: float | None = None,
     ) -> AsyncGenerator[dict[str, Any], None]:
         """Streaming path with status events (reference llm_agent.py:202-252);
         event shapes/messages kept verbatim."""
@@ -439,6 +446,7 @@ class LLMAgent:
             user_context=user_context,
             chat_history=list(chat_history or []),
             tool_calls=deque(),
+            deadline=deadline,
         )
 
         try:

@@ -37,3 +37,7 @@ class AgentState:
     # the response prompt's static prefix (generator.begin_partial handle),
     # taken while retrieval runs and grafted at generation time
     partial_prefill: Any = None
+    # per-request completion deadline (monotonic time.perf_counter; None =
+    # none), threaded serve/app → agent → generator → scheduler for the
+    # shed/EDF admission plane (ROBUSTNESS.md)
+    deadline: float | None = None

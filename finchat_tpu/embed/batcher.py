@@ -40,6 +40,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from finchat_tpu.embed.encoder import EmbeddingEncoder
+from finchat_tpu.utils.faults import inject
 from finchat_tpu.utils.logging import get_logger
 from finchat_tpu.utils.metrics import METRICS
 
@@ -224,6 +225,10 @@ class EmbedMicrobatcher:
         METRICS.inc("finchat_embed_batch_dispatches_total")
         METRICS.set_gauge("finchat_embed_batch_occupancy", n)
         try:
+            # armable fault site (ISSUE 5 satellite): a raised injection is
+            # exactly a failed coalesced dispatch, driving the per-request
+            # retry isolation below
+            inject("embed.dispatch", n_texts=n)
             out = await asyncio.to_thread(self.encoder.embed_batch, texts)
         except Exception as batch_err:
             if len(bucket) == 1:

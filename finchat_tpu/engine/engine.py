@@ -968,6 +968,27 @@ class InferenceEngine:
             k_scales=k_scales, v_scales=v_scales,
         )
 
+    def rebuild_device_state(self) -> None:
+        """Tear down and recreate ALL device-resident engine state — KV
+        pool, page table, context lens, last tokens, rng — with the weights
+        retained (scheduler circuit-breaker recovery: a wedged or poisoned
+        device state is replaced wholesale; in-flight sequences were
+        recompute-preempted to host and replay through admission). The old
+        state is dropped BEFORE the new allocation so peak HBM stays one
+        pool, and the new arrays have identical shapes/dtypes/shardings, so
+        every compiled step variant (warmup's work) remains valid — no
+        recompilation on the recovery path."""
+        self.state = None  # free the old pool before allocating the new one
+        state = create_state(
+            self.config, self.engine_cfg, self.max_pages_per_seq,
+            kv_quant=self.kv_quant,
+        )
+        if self.mesh is not None:
+            from finchat_tpu.parallel.sharding import shard_decode_state
+
+            state = shard_decode_state(state, self.mesh, self.config.n_kv_heads)
+        self.state = state
+
     def _use_ring_prefill(self, prompt_len: int) -> bool:
         return (
             self.mesh is not None

@@ -24,21 +24,34 @@ logger = get_logger(__name__)
 
 
 class GenerationError(RuntimeError):
-    pass
+    """Generation failed. ``code``/``retryable`` carry the scheduler's
+    structured error fields when present (deadline shed, overload) so the
+    serving layer can emit a retryable error chunk instead of an opaque
+    one."""
+
+    def __init__(self, message: str, *, code: str | None = None,
+                 retryable: bool = False):
+        super().__init__(message)
+        self.code = code
+        self.retryable = retryable
 
 
 class TextGenerator(Protocol):
     # ``conversation_id`` keys the engine's cross-turn session KV cache
-    # (engine/session_cache.py); None = no cross-turn reuse. Non-engine
-    # implementations may ignore it.
+    # (engine/session_cache.py); None = no cross-turn reuse. ``deadline``
+    # (monotonic time.perf_counter) feeds the scheduler's shed/EDF
+    # admission; None = no deadline. Non-engine implementations may
+    # ignore both.
     async def stream(
         self, prompt: str, sampling: SamplingParams,
         conversation_id: str | None = None,
+        deadline: float | None = None,
     ) -> AsyncIterator[str]: ...
 
     async def generate(
         self, prompt: str, sampling: SamplingParams,
         conversation_id: str | None = None,
+        deadline: float | None = None,
     ) -> str: ...
 
 
@@ -92,6 +105,7 @@ class EngineGenerator:
     async def begin_partial(
         self, prefix_text: str, sampling: SamplingParams,
         conversation_id: str | None = None,
+        deadline: float | None = None,
     ):
         """Start prefilling a prompt's static prefix while its tail (the
         retrieval graft) is still being computed. Returns an opaque handle
@@ -108,7 +122,7 @@ class EngineGenerator:
             return None
         return await self.scheduler.submit_partial(
             f"seq-{next(self._ids)}", prefix_ids, sampling,
-            conversation_id=conversation_id,
+            conversation_id=conversation_id, deadline=deadline,
         )
 
     def release_partial(self, partial) -> None:
@@ -122,6 +136,7 @@ class EngineGenerator:
         self, prompt: str, sampling: SamplingParams,
         conversation_id: str | None = None,
         partial=None,
+        deadline: float | None = None,
     ) -> AsyncIterator[str]:
         prompt_ids = self.tokenizer.encode(prompt, add_bos=True)
         budget = self.prompt_budget(sampling)
@@ -157,7 +172,7 @@ class EngineGenerator:
             constraint = await self._make_constraint(sampling.grammar) if sampling.grammar else None
             handle = await self.scheduler.submit(
                 seq_id, prompt_ids, sampling, constraint=constraint,
-                conversation_id=conversation_id,
+                conversation_id=conversation_id, deadline=deadline,
             )
         decoder = IncrementalDecoder(self.tokenizer)
         try:
@@ -172,8 +187,14 @@ class EngineGenerator:
                     if tail:
                         yield tail
                     return
-                else:  # error
-                    raise GenerationError(event["message"])
+                else:  # error — carry the scheduler's structured fields
+                    # (deadline shed / overload) so the serving layer can
+                    # emit a retryable error chunk
+                    raise GenerationError(
+                        event["message"],
+                        code=event.get("code"),
+                        retryable=bool(event.get("retryable", False)),
+                    )
         finally:
             if not handle.finished:
                 self.scheduler.cancel(handle)
@@ -182,11 +203,12 @@ class EngineGenerator:
         self, prompt: str, sampling: SamplingParams,
         conversation_id: str | None = None,
         partial=None,
+        deadline: float | None = None,
     ) -> str:
         return "".join([
             piece async for piece in self.stream(
                 prompt, sampling, conversation_id=conversation_id,
-                partial=partial,
+                partial=partial, deadline=deadline,
             )
         ])
 
@@ -221,6 +243,7 @@ class StubGenerator:
     async def stream(
         self, prompt: str, sampling: SamplingParams,
         conversation_id: str | None = None,
+        deadline: float | None = None,
     ) -> AsyncIterator[str]:
         self.calls.append(prompt)
         if self.fail_with is not None:
@@ -235,5 +258,6 @@ class StubGenerator:
     async def generate(
         self, prompt: str, sampling: SamplingParams,
         conversation_id: str | None = None,
+        deadline: float | None = None,
     ) -> str:
         return "".join([piece async for piece in self.stream(prompt, sampling)])

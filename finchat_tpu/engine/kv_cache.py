@@ -181,6 +181,16 @@ class PageAllocator:
     def owned_by(self, seq_id: str) -> list[int]:
         return [p for p, s in self._owner.items() if s == seq_id]
 
+    def reset(self) -> None:
+        """Return EVERY page to the free list, dropping all ownership —
+        the engine-rebuild path (scheduler breaker trip): the device KV
+        pool was just torn down and recreated, so nothing the old owners
+        pointed at exists anymore. Never valid while any owner still
+        expects its pages to hold live KV."""
+        self._free = list(range(self.num_pages - 1, 0, -1))
+        self._owner.clear()
+        METRICS.set_gauge("finchat_kv_pages_used", 0)
+
     def check_invariants(self) -> None:
         """Every page is exactly one of {trash, free, owned-once}."""
         free_set = set(self._free)

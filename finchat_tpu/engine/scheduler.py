@@ -55,6 +55,19 @@ model replica:
   evicted, its pages freed, an error event emitted on its stream, and the
   engine keeps serving the others. The process-level watchdog of the
   reference becomes per-sequence.
+- Resilience plane (ISSUE 5; ROBUSTNESS.md): recompute preemption
+  (``_preempt`` — free a victim's slot and KV pages but keep prompt +
+  generated tokens on the handle; re-admission re-prefills and resumes
+  with zero duplicate or dropped tokens), used for page pressure (the
+  lowest-priority victim yields instead of the head-of-line stalling) and
+  as the recovery primitive of the engine circuit breaker
+  (``breaker_threshold`` consecutive failed dispatch rounds → all live
+  sequences preempt to host, the device state is torn down and rebuilt
+  with weights retained, a half-open probe round re-admits). Deadline
+  admission: pending requests past their deadline are shed pre-admission
+  with a structured retryable error, admission orders
+  earliest-deadline-first with a starvation guard, and ``submit`` rejects
+  above ``max_queue_depth`` (backpressure instead of an unbounded queue).
 - Invariants (SURVEY §5.2): the page allocator's ownership checks run at
   every free; slot bookkeeping is single-task (the step loop) by design.
 """
@@ -83,6 +96,15 @@ from finchat_tpu.utils.metrics import METRICS, Timer
 from finchat_tpu.utils.tracing import RequestSpan
 
 logger = get_logger(__name__)
+
+
+class OverloadedError(RuntimeError):
+    """``submit`` rejected: the admission queue is at ``max_queue_depth``.
+    Retryable by contract — the serving layer surfaces it as a structured
+    retryable error chunk instead of an opaque failure."""
+
+    code = "overloaded"
+    retryable = True
 
 
 @dataclass
@@ -136,6 +158,22 @@ class SequenceHandle:
     # keep the chunked prefill path (the seq-sharded ring paths assume
     # they owned the prompt from position 0 / their own segment schedule)
     grafted: bool = False
+    # completion deadline on the scheduler's monotonic clock
+    # (time.perf_counter); None = no deadline. Pending entries past it are
+    # shed pre-admission; admission orders earliest-deadline-first; page
+    # pressure preempts the latest-deadline victim for a strictly-earlier
+    # candidate.
+    deadline: float | None = None
+    # recompute preemptions survived (page pressure / breaker recovery) —
+    # a preempted handle's prompt_ids become its full history and it
+    # re-admits through the normal path
+    preempted: int = 0
+    # admission epoch: bumped by _preempt so a dispatch's membership
+    # snapshot (captured as (slot, handle, epoch)) can tell a REPLAYED
+    # incarnation from the one it was dispatched against — the same handle
+    # can re-admit into the same slot while a stale step is still
+    # unconsumed, and slot identity alone would double-deliver its token
+    epoch: int = 0
     submitted_at: float = field(default_factory=time.perf_counter)
     first_token_at: float | None = None
     # host arrival time of the last delivered token — feeds the
@@ -161,11 +199,13 @@ class SequenceHandle:
 @dataclass
 class _InFlightStep:
     """A dispatched-but-unconsumed decode step (device arrays + the
-    membership snapshot it was dispatched against)."""
+    membership snapshot it was dispatched against; members carry the
+    handle's admission epoch so a preempted-and-replayed incarnation
+    never receives a stale token)."""
 
     tokens: object  # [max_seqs] int32, device
     logits: object | None  # [n_constrained, vocab] fp32 device slice, or None
-    members: list[tuple[int, SequenceHandle]]
+    members: list[tuple[int, SequenceHandle, int]]
     constrained_slots: list[int]
 
 
@@ -178,7 +218,7 @@ class _InFlightBlock:
     iteration, if any."""
 
     block_tokens: object  # [K, max_seqs] int32, device (-1 = no token)
-    block_members: list[tuple[int, SequenceHandle]]
+    block_members: list[tuple[int, SequenceHandle, int]]
     step: _InFlightStep | None
 
 
@@ -281,6 +321,31 @@ class ContinuousBatchingScheduler:
         # would flood the log under load (the clamp itself still applies and
         # is counted in finchat_top_k_clamped_total)
         self._top_k_clamp_warned: set[int] = set()
+        # --- resilience plane (ISSUE 5) ---------------------------------
+        # engine circuit breaker: consecutive whole-round dispatch failures
+        # per plane ("prefill" / "decode" — mixed and spec ride the decode
+        # bucket) before the breaker trips and the device state is rebuilt.
+        # 0 disables the breaker (legacy: a whole-round failure evicts its
+        # in-flight sequences with an error).
+        self.breaker_threshold = max(0, cfg.breaker_threshold)
+        self.breaker_max_rebuilds = max(1, cfg.breaker_max_rebuilds)
+        self.preemption_enabled = bool(cfg.preemption)
+        self.edf_starvation_s = max(0.0, cfg.edf_starvation_seconds)
+        self.max_queue_depth = max(0, cfg.max_queue_depth)
+        self._fail_streaks = {"prefill": 0, "decode": 0}
+        self._rebuilds_without_success = 0
+        self._breaker_tripped_at: float | None = None
+        # which plane tripped the breaker: only a successful round of THAT
+        # plane closes it (a decode-wedged engine keeps prefilling fine —
+        # prefill successes must not mask the wedge or reset the
+        # consecutive-rebuild give-up counter)
+        self._breaker_bucket: str | None = None
+        # callbacks run after an engine rebuild (the serving layer uses one
+        # to re-register its shared prompt heads — the rebuild dropped them)
+        self.on_rebuild: list = []
+        # breaker state gauge: 0 closed, 1 open (rebuilding), 2 half-open
+        # (rebuilt, awaiting the first successful probe round)
+        METRICS.set_gauge("finchat_breaker_state", 0)
         # session KV cache (engine/session_cache.py): host-RAM tier keyed by
         # conversation_id; None = disabled. The on_drop hook is where entry
         # references on shared-prefix pages are released.
@@ -318,9 +383,19 @@ class ContinuousBatchingScheduler:
         sampling: SamplingParams,
         constraint: TokenConstraint | None = None,
         conversation_id: str | None = None,
+        deadline: float | None = None,
     ) -> SequenceHandle:
         if not prompt_ids:
             raise ValueError("empty prompt")
+        if self.max_queue_depth > 0 and len(self.pending) >= self.max_queue_depth:
+            # backpressure: reject NEW load above the bound with a
+            # retryable error instead of queueing unboundedly (preempted
+            # sequences bypass submit — they are live streams, not load)
+            METRICS.inc("finchat_overload_rejections_total")
+            raise OverloadedError(
+                f"admission queue full ({len(self.pending)} >= "
+                f"{self.max_queue_depth}); retry with backoff"
+            )
         max_len = self.engine.max_pages_per_seq * self.engine.page_size
         if len(prompt_ids) + sampling.max_new_tokens > max_len:
             raise ValueError(
@@ -347,6 +422,7 @@ class ContinuousBatchingScheduler:
         handle = SequenceHandle(
             seq_id=seq_id, prompt_ids=list(prompt_ids), sampling=sampling,
             constraint=constraint, conversation_id=conversation_id,
+            deadline=deadline,
         )
         self.pending.append(handle)
         METRICS.set_gauge("finchat_queue_depth", len(self.pending))
@@ -364,6 +440,7 @@ class ContinuousBatchingScheduler:
         prefix_ids: list[int],
         sampling: SamplingParams,
         conversation_id: str | None = None,
+        deadline: float | None = None,
     ) -> SequenceHandle | None:
         """Start prefilling a prompt whose TAIL is not known yet (the
         retrieval/prefill overlap path): ``prefix_ids`` is the static
@@ -385,7 +462,8 @@ class ContinuousBatchingScheduler:
         if self.engine._use_ring_prefill(len(prefix_ids)):
             return None
         handle = await self.submit(
-            seq_id, prefix_ids, sampling, conversation_id=conversation_id
+            seq_id, prefix_ids, sampling, conversation_id=conversation_id,
+            deadline=deadline,
         )
         # no await ran between submit() appending to pending and here (the
         # scheduler loop is a separate task), so the hold flags are set
@@ -626,14 +704,74 @@ class ContinuousBatchingScheduler:
         self._evict(handle, "cancelled")
 
     # --- internals ------------------------------------------------------
+    @staticmethod
+    def _remaining_new(handle: SequenceHandle) -> int:
+        """Tokens this sequence may still generate — what its KV allocation
+        must cover beyond the prompt. Equals ``max_new_tokens`` for a fresh
+        submission; a preempted replay's prompt already CONTAINS its
+        generated tokens, so sizing by the full budget would over-reserve
+        by exactly that amount."""
+        return max(1, handle.sampling.max_new_tokens - handle.generated)
+
+    def _shed_expired(self) -> None:
+        """Deadline load shedding: pending requests past their deadline are
+        dropped PRE-admission with a structured retryable error — admitting
+        them would spend prefill compute on an answer the caller has
+        already given up on. Live streams are never shed: a preempted
+        handle was admitted once and owes its client the rest of the
+        stream, so it replays regardless of deadline."""
+        if not self.pending:
+            return
+        now = time.perf_counter()
+        for handle in list(self.pending):
+            if (handle.deadline is not None and now > handle.deadline
+                    and handle.generated == 0 and not handle.preempted):
+                self.pending.remove(handle)
+                METRICS.inc("finchat_sheds_total")
+                handle.finished = True
+                handle.span.finish()
+                handle.events.put_nowait({
+                    "type": "error",
+                    "message": "deadline exceeded before admission; retry with backoff",
+                    "code": "deadline_exceeded",
+                    "retryable": True,
+                })
+        METRICS.set_gauge("finchat_queue_depth", len(self.pending))
+
+    def _prepare_pending(self) -> None:
+        """Shed expired entries, then order the queue for admission:
+        earliest deadline first (deadline-less entries last, FIFO among
+        themselves) with a starvation guard — an entry that has waited
+        longer than ``edf_starvation_seconds`` jumps ahead of deadline
+        order (FIFO among the starved), so a stream of tight-deadline
+        arrivals cannot starve a far-deadline request forever. A pure
+        FIFO workload (no deadlines anywhere) is left untouched. Runs up
+        to thrice per loop iteration (preemption plan, post-drain
+        re-plan, admission) by design: the queue is bounded by
+        max_queue_depth and timsort on an already-ordered deque is ~O(n),
+        so re-establishing the order beats threading staleness flags
+        through the loop."""
+        self._shed_expired()
+        if len(self.pending) <= 1 or all(h.deadline is None for h in self.pending):
+            return
+        now = time.perf_counter()
+
+        def key(h: SequenceHandle):
+            if now - h.submitted_at > self.edf_starvation_s:
+                return (0, 0.0)  # starved: ahead of EDF, FIFO (stable sort)
+            return (1, h.deadline if h.deadline is not None else float("inf"))
+
+        self.pending = deque(sorted(self.pending, key=key))
+
     def _admit(self) -> None:
+        self._prepare_pending()
         admitted: dict[int, list[int]] = {}
         ctx_rows: dict[int, int] = {}
         page = self.engine.page_size
         while self.pending and self.free_slots:
             handle = self.pending[0]
             total = pages_needed(
-                len(handle.prompt_ids) + handle.sampling.max_new_tokens, page
+                len(handle.prompt_ids) + self._remaining_new(handle), page
             )
             if total > self.engine.max_pages_per_seq:
                 break  # head-of-line waits for pages (rejected at submit anyway)
@@ -684,6 +822,7 @@ class ContinuousBatchingScheduler:
             pages = self.allocator.allocate(handle.seq_id, need)
             if n_restore:
                 try:
+                    inject("session.restore", seq_id=handle.seq_id)
                     with Timer(METRICS, "finchat_session_restore_seconds"):
                         self.engine.restore_pages(pages[:n_restore], s_entry.snap)
                     METRICS.inc("finchat_session_cache_restored_tokens_total",
@@ -829,6 +968,7 @@ class ContinuousBatchingScheduler:
                 reuse_pages = 0  # entry replaced by a different stream since
         own_ids = handle.page_list[shared // page + reuse_pages : n_tok // page]
         try:
+            inject("session.offload", seq_id=handle.seq_id)
             with Timer(METRICS, "finchat_session_offload_seconds"):
                 snap_new = self.engine.offload_pages(own_ids) if own_ids else None
         except Exception as e:  # cache is an optimization; never fail eviction
@@ -868,6 +1008,284 @@ class ContinuousBatchingScheduler:
         else:
             self._finish(handle, reason)
 
+    # --- resilience plane (ISSUE 5; ROBUSTNESS.md) ----------------------
+    def _preempt(self, handle: SequenceHandle, *, for_rebuild: bool = False) -> None:
+        """Recompute preemption: free the victim's slot and KV pages but
+        keep its prompt AND already-generated tokens on the handle. The
+        replay plan sets ``prompt_ids = history`` (prompt + delivered
+        tokens), so re-admission re-prefills exactly the stream so far —
+        composing with the shared-prefix and session caches, which makes
+        the replay usually cheap — and the commit at replay-prefill
+        completion samples precisely the NEXT token: zero duplicate or
+        dropped tokens on the stream (greedy replay is byte-identical;
+        tests/test_resilience.py pins it). Any token of the victim still
+        riding an in-flight dispatch is discarded at consume time
+        (``handle.slot`` is -1 by then) and recomputed by the replay.
+
+        Used for page pressure (the latest-deadline victim yields instead
+        of the earliest-deadline candidate stalling head-of-line) and as
+        the circuit breaker's recovery primitive. ``for_rebuild`` skips
+        per-slot device resets — the whole device state is about to be
+        replaced and the engine may be wedged."""
+        if handle.finished:
+            return
+        slot = handle.slot
+        if slot >= 0:
+            pages = self.allocator.owned_by(handle.seq_id)
+            if pages:
+                self.allocator.free(handle.seq_id, pages)
+            self.decoding.pop(slot, None)
+            if handle in self.prefilling:
+                self.prefilling.remove(handle)
+            self._temperature[slot] = 0.0
+            self._top_p[slot] = 1.0
+            self._top_k[slot] = 0
+            self.free_slots.append(slot)
+            handle.slot = -1
+            if handle.prefix_entry is not None:
+                handle.prefix_entry.refs -= 1
+                handle.prefix_entry = None
+                if not for_rebuild:
+                    self._reap_prefixes()
+            if not for_rebuild:
+                try:
+                    self.engine.reset_slot(slot)
+                except Exception as e:
+                    # survivable: admission rewrites the page-table row and
+                    # context length; a wedged device trips the breaker
+                    logger.error("slot reset failed preempting %s: %s",
+                                 handle.seq_id, e)
+        elif handle in self.pending:
+            return  # already queued; nothing to preempt
+        handle.prompt_ids = list(handle.history)
+        handle.prefill_pos = 0
+        handle.page_list = []
+        handle.shared_len = 0
+        handle.resumed_len = 0
+        handle.ring_path = False
+        handle.grafted = False
+        handle.preempted += 1
+        handle.epoch += 1  # invalidate stale dispatch-membership snapshots
+        # preempted sequences re-admit ahead of new load: they are live
+        # streams mid-answer, and _prepare_pending's EDF ordering applies
+        # on top when deadlines are in play
+        self.pending.appendleft(handle)
+        METRICS.inc("finchat_preemptions_total")
+        METRICS.set_gauge("finchat_queue_depth", len(self.pending))
+        self._wakeup.set()
+
+    def _preemption_plan(self) -> list[SequenceHandle]:
+        """Page-pressure preemption policy: when the earliest-deadline
+        pending request cannot be admitted for lack of KV pages, return
+        the latest-deadline decoding victims (deadline-less = lowest
+        priority) whose deadlines are STRICTLY later than the candidate's
+        and whose pages would make the admission fit. Strict deadline
+        order makes the policy livelock-free: a victim can never in turn
+        preempt the sequence it yielded to. Returns [] when preemption is
+        off, nothing is stalled, no eligible victim exists, or even
+        preempting every eligible victim would not free enough pages
+        (preempting without admitting would be pure loss). Planning only —
+        the loop drains the in-flight dispatch before executing the plan,
+        so no freed page can still be a target of queued device writes."""
+        if not self.preemption_enabled or not self.pending or not self.decoding:
+            return []
+        self._prepare_pending()
+        if not self.pending:
+            return []
+        cand = self.pending[0]
+        if cand.deadline is None:
+            return []  # only deadline urgency justifies evicting live KV
+        page = self.engine.page_size
+        total = pages_needed(
+            len(cand.prompt_ids) + self._remaining_new(cand), page
+        )
+        if total > self.engine.max_pages_per_seq:
+            return []
+        # prefix-aware need (same plan _admit will compute): an admission
+        # a shared head would satisfy must not trigger a preemption
+        ring = self.engine._use_ring_prefill(len(cand.prompt_ids))
+        if ring and self.engine.ring_segment_tokens() == 0:
+            shared_len = 0
+        else:
+            _, shared_len = self._match_prefix(cand.prompt_ids)
+        need = total - shared_len // page
+        if self.free_slots and self.allocator.can_allocate(need):
+            return []  # admissible as-is; _admit will take it
+
+        def eff(h: SequenceHandle) -> float:
+            return h.deadline if h.deadline is not None else float("inf")
+
+        pool = [h for h in self.decoding.values()
+                if not h.finished and eff(h) > cand.deadline]
+        pool.sort(key=eff, reverse=True)
+        victims: list[SequenceHandle] = []
+        freeable = self.allocator.free_count
+        for v in pool:
+            victims.append(v)
+            freeable += len(self.allocator.owned_by(v.seq_id))
+            if freeable >= need:
+                return victims
+        return []
+
+    def _round_failed(self, scope: str, error: str) -> None:
+        """A whole-round dispatch failure — not attributable to one
+        sequence. Breaker off (``breaker_threshold`` 0): legacy behavior,
+        the round's population is evicted with an error. Breaker on: the
+        failure streak for the plane ('prefill' or 'decode'; mixed and
+        spec ride 'decode') advances — below the threshold the round's
+        sequences are recompute-preempted and replay through admission (a
+        transient blip costs a re-prefill, not the stream); at the
+        threshold the breaker trips and the engine device state is
+        rebuilt. Dispatches are never re-consumed after a failure: a
+        partially-consumed step cannot be told apart from an unconsumed
+        one, and replay recomputes any undelivered token anyway."""
+        METRICS.inc("finchat_dispatch_failures_total")
+        if self.breaker_threshold <= 0:
+            if scope in ("prefill", "mixed"):
+                self._fail_prefill_round(error)
+            if scope in ("decode", "mixed", "spec"):
+                for handle in list(self.decoding.values()):
+                    self._evict(handle, "error", error=error)
+            return
+        bucket = "prefill" if scope == "prefill" else "decode"
+        self._fail_streaks[bucket] += 1
+        if self._fail_streaks[bucket] >= self.breaker_threshold:
+            self._trip_breaker(bucket, error)
+            return
+        if scope in ("prefill", "mixed"):
+            for handle in list(self.prefilling):
+                if not self._parked(handle):
+                    self._preempt(handle)
+            for job in list(self._prefix_jobs):
+                try:  # registration is best-effort by contract
+                    self._fail_prefix_job(job)
+                except Exception as e:
+                    logger.error("failing prefix job during %s failure: %s",
+                                 scope, e)
+        if scope in ("decode", "mixed", "spec"):
+            for handle in list(self.decoding.values()):
+                self._preempt(handle)
+
+    def _note_round_ok(self, bucket: str) -> None:
+        """A dispatch round of ``bucket`` completed: its failure streak
+        resets; if this is the plane that tripped the breaker, the
+        half-open breaker closes (recovery latency observed from trip to
+        here) and the consecutive-rebuild give-up counter clears."""
+        self._fail_streaks[bucket] = 0
+        if self._breaker_bucket in (None, bucket):
+            self._rebuilds_without_success = 0
+            self._breaker_bucket = None
+            if self._breaker_tripped_at is not None:
+                METRICS.observe(
+                    "finchat_breaker_recovery_seconds",
+                    time.perf_counter() - self._breaker_tripped_at,
+                )
+                self._breaker_tripped_at = None
+                METRICS.set_gauge("finchat_breaker_state", 0)
+
+    def _trip_breaker(self, bucket: str, error: str) -> None:
+        """Breaker trip: preempt every live sequence to host, tear down
+        and rebuild the engine's device state (weights retained, compiled
+        variants still valid — shapes are unchanged), reset the page
+        allocator and slot bookkeeping, and drop every cache entry that
+        referenced device pages (shared-prefix heads, session entries with
+        referenced heads). The next loop iteration is the half-open probe:
+        admission re-admits via the recompute path, and the first
+        successful round closes the breaker. ``breaker_max_rebuilds``
+        consecutive trips without a successful round in between give up
+        and fail the in-flight streams — a persistently wedged engine
+        must not rebuild-loop forever."""
+        self._breaker_bucket = bucket
+        self._rebuilds_without_success += 1
+        if self._rebuilds_without_success > self.breaker_max_rebuilds:
+            logger.error(
+                "breaker: %d consecutive rebuilds without a successful round; "
+                "failing in-flight streams (%s)",
+                self._rebuilds_without_success - 1, error,
+            )
+            for handle in list(self.decoding.values()) + list(self.prefilling):
+                try:
+                    self._evict(handle, "error", error=error)
+                except Exception as e:
+                    logger.error("evicting %s after breaker give-up: %s",
+                                 handle.seq_id, e)
+            for job in list(self._prefix_jobs):
+                try:  # slot + pages must come back even on give-up
+                    self._fail_prefix_job(job)
+                except Exception as e:
+                    logger.error("failing prefix job at breaker give-up: %s", e)
+            for bucket in self._fail_streaks:
+                self._fail_streaks[bucket] = 0
+            # the scheduler keeps serving new admissions (degraded): close
+            # the gauge and drop the trip timestamp so a later recovery
+            # doesn't record the whole given-up idle period as latency —
+            # _rebuilds_without_success deliberately persists, so another
+            # trip without an intervening success gives up immediately
+            self._breaker_tripped_at = None
+            METRICS.set_gauge("finchat_breaker_state", 0)
+            return
+        logger.error("breaker tripped (%s): preempting %d live sequences and "
+                     "rebuilding engine device state", error,
+                     len(self.decoding) + len(self.prefilling))
+        if self._breaker_tripped_at is None:
+            self._breaker_tripped_at = time.perf_counter()
+        METRICS.set_gauge("finchat_breaker_state", 1)
+        for handle in list(self.decoding.values()):
+            self._preempt(handle, for_rebuild=True)
+        for handle in list(self.prefilling):
+            # parked overlap holds included: their prefix KV is going away,
+            # so they re-prefill and park again awaiting extend_prompt
+            self._preempt(handle, for_rebuild=True)
+        for job in list(self._prefix_jobs):
+            # no device ops here (the engine may be wedged): the slot and
+            # pages are reclaimed wholesale by the resets below
+            self._prefix_jobs.remove(job)
+            if not job.future.done():
+                job.future.set_result(0)
+        # caches referencing device pages reference a pool that no longer
+        # exists: session entries with a referenced head are purged (their
+        # on_drop releases the head refs), then the head entries drop
+        if self.session_cache is not None:
+            self.session_cache.discard_if(
+                lambda e: e.prefix_len > 0 or e.prefix_entry is not None
+            )
+        self._prefixes.clear()
+        # host bookkeeping resets BEFORE the rebuild attempt: the old
+        # device pool is discarded either way (rebuild drops it first), so
+        # this also reclaims the prefix jobs' pages/slots wholesale — a
+        # rebuild failure must not strand them owned by dead registrants
+        # and stall admission forever
+        self.allocator.reset()
+        self.free_slots = list(range(self.engine.engine_cfg.max_seqs))
+        self._temperature[:] = 0.0
+        self._top_p[:] = 1.0
+        self._top_k[:] = 0
+        try:
+            with Timer(METRICS, "finchat_engine_rebuild_seconds"):
+                self.engine.rebuild_device_state()
+        except Exception as e:
+            # rebuild itself failed (device gone?): fail what we hold and
+            # leave the breaker open — the next trip retries the rebuild
+            logger.error("engine rebuild failed: %s", e)
+            for handle in list(self.pending):
+                if handle.preempted:
+                    self.pending.remove(handle)
+                    handle.finished = True
+                    handle.span.finish()
+                    handle.events.put_nowait(
+                        {"type": "error", "message": f"engine rebuild failed: {e}"}
+                    )
+            return
+        for bucket in self._fail_streaks:
+            self._fail_streaks[bucket] = 0
+        METRICS.inc("finchat_engine_rebuilds_total")
+        METRICS.set_gauge("finchat_breaker_state", 2)  # half-open
+        for cb in list(self.on_rebuild):
+            try:
+                cb()
+            except Exception as e:
+                logger.error("on_rebuild callback failed: %s", e)
+
     async def _prefill_round(self) -> None:
         """Advance EVERY currently-prefilling sequence one chunk in a single
         batched ``prefill_step`` (one weights-read for the whole round). The
@@ -881,8 +1299,10 @@ class ContinuousBatchingScheduler:
         eng = self.engine
         C = eng.engine_cfg.prefill_chunk
         batch: list[SequenceHandle] = []
-        # (handle, device logits row) pairs whose prompt completed this round
-        completions: list[tuple[SequenceHandle, object]] = []
+        # (handle, device logits row, epoch) triples whose prompt completed
+        # this round — the epoch tells a preempted-and-replayed incarnation
+        # from the one this round prefilled
+        completions: list[tuple[SequenceHandle, object, int]] = []
         for handle in list(self.prefilling):
             if self._parked(handle):
                 continue  # awaiting extend_prompt
@@ -901,7 +1321,7 @@ class ContinuousBatchingScheduler:
                         with Timer(METRICS, "finchat_prefill_seconds"):
                             ring_logits = eng.prefill_ring(handle.slot, handle.prompt_ids)
                         handle.prefill_pos = len(handle.prompt_ids)
-                        completions.append((handle, ring_logits))
+                        completions.append((handle, ring_logits, handle.epoch))
                         continue
                     # chunked ring: ONE segment per round — decode steps
                     # interleave between segments, so one long prompt no
@@ -916,7 +1336,7 @@ class ContinuousBatchingScheduler:
                         )
                     handle.prefill_pos += len(seg)
                     if handle.prefill_pos >= len(handle.prompt_ids):
-                        completions.append((handle, seg_logits))
+                        completions.append((handle, seg_logits, handle.epoch))
                     continue
             except Exception as e:  # per-sequence isolation
                 logger.error("prefill error for %s: %s", handle.seq_id, e)
@@ -950,7 +1370,7 @@ class ContinuousBatchingScheduler:
                     if handle.held:
                         continue  # park: the first token commits only
                         # after extend_prompt grafts the real prompt end
-                    completions.append((handle, logits[i]))
+                    completions.append((handle, logits[i], handle.epoch))
             for i, job in enumerate(jobs, start=len(batch)):
                 job.pos += int(n_valids[i])
                 if job.pos >= job.shared_len:
@@ -960,7 +1380,7 @@ class ContinuousBatchingScheduler:
             return  # dispatch-only round, no host sync needed
 
         tokens_dev = []
-        for h, row_logits in completions:
+        for h, row_logits, _e in completions:
             h.span.mark("prefill_done")
             s = h.sampling
             eng.state, token = commit_first_token(
@@ -974,13 +1394,13 @@ class ContinuousBatchingScheduler:
                 [int(np.asarray(t)) for t in tokens_dev],
                 [
                     np.asarray(row_logits) if h.constraint is not None else None
-                    for h, row_logits in completions
+                    for h, row_logits, _e in completions
                 ],
             )
         )
-        for (handle, _), token_id, row_host in zip(completions, fetched, logits_host):
-            if handle.finished:  # cancelled while fetching
-                continue
+        for (handle, _lg, epoch), token_id, row_host in zip(completions, fetched, logits_host):
+            if handle.finished or handle.epoch != epoch:
+                continue  # cancelled/preempted while fetching
             try:
                 if handle.constraint is not None:
                     token_id = self._constrained_pick(handle, row_host)
@@ -1089,12 +1509,18 @@ class ContinuousBatchingScheduler:
                 continue
             batch.append(handle)
         jobs = list(self._prefix_jobs)
-        decode_members = list(self.decoding.items())
+        decode_members = [
+            (slot, h, h.epoch) for slot, h in self.decoding.items()
+        ]
         rows = [(h.slot, h.prompt_ids, h.prefill_pos) for h in batch]
         rows += [(j.slot, j.ids, j.pos) for j in jobs]
         if not rows or not decode_members:
             return  # a fault above drained one side; split paths resume next tick
         inject("scheduler.decode")
+        # mixed-specific armable site (ISSUE 5 satellite): targets ONLY the
+        # unified dispatch, so tests can fail the fused round while the
+        # split fallback paths stay healthy
+        inject("scheduler.mixed")
         from finchat_tpu.engine.engine import round_up_pow2
 
         # chunk bucket: decode rows pay dense compute for every padded
@@ -1110,7 +1536,7 @@ class ContinuousBatchingScheduler:
         temp = np.zeros((N,), np.float32)
         top_p = np.ones((N,), np.float32)
         top_k = np.zeros((N,), np.int32)
-        completions: list[tuple[int, SequenceHandle]] = []
+        completions: list[tuple[int, SequenceHandle, int]] = []
         for i, h in enumerate(batch):
             if h.held or h.prefill_pos + int(n_valids[i]) < len(h.prompt_ids):
                 continue
@@ -1119,9 +1545,9 @@ class ContinuousBatchingScheduler:
             arm[i] = True
             s = h.sampling
             temp[i], top_p[i], top_k[i] = s.temperature, s.top_p, s.top_k
-            completions.append((i, h))
+            completions.append((i, h, h.epoch))
         base = len(rows)
-        for d, (slot, _h) in enumerate(decode_members):
+        for d, (slot, _h, _e) in enumerate(decode_members):
             i = base + d
             slots[i] = slot
             n_valids[i] = 1
@@ -1145,9 +1571,9 @@ class ContinuousBatchingScheduler:
         # ONE host fetch serves the decode tokens AND the completions'
         # first tokens (worker thread keeps the event loop live)
         toks_host = await asyncio.to_thread(lambda: np.asarray(next_tokens))
-        for i, handle in completions:
-            if handle.finished:
-                continue  # cancelled while fetching
+        for i, handle, epoch in completions:
+            if handle.finished or handle.epoch != epoch:
+                continue  # cancelled/preempted while fetching
             handle.span.mark("prefill_done")
             try:
                 self.prefilling.remove(handle)
@@ -1156,9 +1582,9 @@ class ContinuousBatchingScheduler:
             except Exception as e:  # per-sequence isolation
                 logger.error("prefill completion error for %s: %s", handle.seq_id, e)
                 self._evict(handle, "error", error=str(e))
-        for d, (slot, handle) in enumerate(decode_members):
-            if handle.finished or handle.slot != slot:
-                continue  # evicted/cancelled since dispatch; token discarded
+        for d, (slot, handle, epoch) in enumerate(decode_members):
+            if handle.finished or handle.slot != slot or handle.epoch != epoch:
+                continue  # evicted/cancelled/preempted since dispatch
             self._deliver(handle, int(toks_host[base + d]))
         METRICS.set_gauge("finchat_batch_occupancy", len(self.decoding))
 
@@ -1210,13 +1636,13 @@ class ContinuousBatchingScheduler:
             if slot in exclude:
                 continue
             active[slot] = True
-            members.append((slot, handle))
+            members.append((slot, handle, handle.epoch))
         # step logits come back to host only while a grammar-constrained
         # sequence is IN this step (a second compiled decode variant), and
         # only the constrained rows are transferred — a [n, vocab] device
         # slice, not the whole batch's [B, vocab].
         constrained_slots = sorted(
-            slot for slot, h in members if h.constraint is not None
+            slot for slot, h, _e in members if h.constraint is not None
         )
         need_logits = bool(constrained_slots)
         result = eng.decode(
@@ -1246,12 +1672,12 @@ class ContinuousBatchingScheduler:
         if inflight is None:
             return {}
         if isinstance(inflight, _InFlightBlock):
-            ahead = {slot: self.loop_depth for slot, _ in inflight.block_members}
+            ahead = {slot: self.loop_depth for slot, _h, _e in inflight.block_members}
             if inflight.step is not None:
-                for slot, _ in inflight.step.members:
+                for slot, _h, _e in inflight.step.members:
                     ahead[slot] = 1
             return ahead
-        return {slot: 1 for slot, _ in inflight.members}
+        return {slot: 1 for slot, _h, _e in inflight.members}
 
     def _loop_eligible(self, handle: SequenceHandle, ahead: int = 0) -> bool:
         """Can this slot ride a fused K-token block? It must need NO
@@ -1292,7 +1718,7 @@ class ContinuousBatchingScheduler:
                 continue
             if self._loop_eligible(handle, ahead.get(slot, 0)):
                 active[slot] = True
-                block_members.append((slot, handle))
+                block_members.append((slot, handle, handle.epoch))
             else:
                 demoted.add(slot)
         token_block = eng.decode_loop(
@@ -1326,9 +1752,9 @@ class ContinuousBatchingScheduler:
         )
         K = tokens_host.shape[0]
         wasted = 0
-        for slot, handle in blk.block_members:
-            if handle.finished or handle.slot != slot:
-                wasted += K  # evicted/cancelled since dispatch
+        for slot, handle, epoch in blk.block_members:
+            if handle.finished or handle.slot != slot or handle.epoch != epoch:
+                wasted += K  # evicted/cancelled/preempted since dispatch
                 continue
             for i in range(K):
                 token = int(tokens_host[i, slot])
@@ -1412,7 +1838,7 @@ class ContinuousBatchingScheduler:
         members = []
         for slot, handle in self.decoding.items():
             active[slot] = True
-            members.append((slot, handle))
+            members.append((slot, handle, handle.epoch))
             if self._spec_eligible(handle):
                 if handle.ngram_index is None:  # one-time build; _deliver
                     handle.ngram_index = NgramIndex(handle.history)  # keeps it in sync
@@ -1430,7 +1856,7 @@ class ContinuousBatchingScheduler:
             return
 
         constrained_slots = sorted(
-            slot for slot, h in members if h.constraint is not None
+            slot for slot, h, _e in members if h.constraint is not None
         )
         need_logits = bool(constrained_slots)
         result = eng.decode_spec(
@@ -1452,9 +1878,9 @@ class ContinuousBatchingScheduler:
             )
         )
         accepted_total = 0
-        for slot, handle in members:
-            if handle.finished or handle.slot != slot:
-                continue  # evicted/cancelled since dispatch
+        for slot, handle, epoch in members:
+            if handle.finished or handle.slot != slot or handle.epoch != epoch:
+                continue  # evicted/cancelled/preempted since dispatch
             if handle.constraint is not None and logits_host is not None:
                 token = self._constrained_pick(
                     handle, logits_host[constrained_slots.index(slot)]
@@ -1483,9 +1909,9 @@ class ContinuousBatchingScheduler:
             )
         )
         eng = self.engine
-        for slot, handle in step.members:
-            if handle.finished or handle.slot != slot:
-                continue  # evicted/cancelled since dispatch; token discarded
+        for slot, handle, epoch in step.members:
+            if handle.finished or handle.slot != slot or handle.epoch != epoch:
+                continue  # evicted/cancelled/preempted since dispatch
             if handle.constraint is not None and logits_host is not None:
                 token = self._constrained_pick(
                     handle, logits_host[step.constrained_slots.index(slot)]
@@ -1509,6 +1935,22 @@ class ContinuousBatchingScheduler:
         else:
             await self._consume_step(inflight)
 
+    async def _drain_inflight(self, inflight) -> None:
+        """Consume an in-flight dispatch OUTSIDE the decode try-block
+        (idle drain, pre-mixed drain, pre-preemption drain), converting a
+        failure into the whole-round recovery path instead of letting it
+        kill the scheduler task. A failed consume is never retried — a
+        partially-consumed step cannot be told apart from an unconsumed
+        one, and preempt/replay recomputes the undelivered tokens.
+        Always returns None (the caller's new ``inflight``)."""
+        try:
+            await self._consume_inflight(inflight)
+            self._note_round_ok("decode")
+        except Exception as e:
+            logger.error("in-flight step consume error: %s", e)
+            self._round_failed("decode", str(e))
+        return None
+
     async def _loop(self) -> None:
         logger.info("scheduler loop started (max_seqs=%d)", self.engine.engine_cfg.max_seqs)
         inflight: _InFlightStep | _InFlightBlock | None = None
@@ -1521,8 +1963,7 @@ class ContinuousBatchingScheduler:
                     or self._prefill_work()):
                 if inflight is not None:  # drain the pipeline before idling
                     self._iter_ran_prefill = False
-                    await self._consume_inflight(inflight)
-                    inflight = None
+                    inflight = await self._drain_inflight(inflight)
                     continue
                 self._wakeup.clear()
                 try:
@@ -1531,7 +1972,33 @@ class ContinuousBatchingScheduler:
                     pass
                 continue
 
-            self._admit()
+            try:
+                # page-pressure preemption (ISSUE 5): planned BEFORE any
+                # dispatch and executed only after the in-flight step is
+                # drained, so a freed page can never still be the target of
+                # queued device writes
+                victims = self._preemption_plan()
+                if victims:
+                    if inflight is not None:
+                        inflight = await self._drain_inflight(inflight)
+                        # consuming may have retired slots / freed pages
+                        # (or, on a drain failure, preempted the victims
+                        # already) — recompute the plan either way
+                        victims = self._preemption_plan()
+                    cand = self.pending[0].seq_id if self.pending else "?"
+                    for victim in victims:
+                        logger.info(
+                            "page pressure: preempting %s (deadline %.3f) for %s",
+                            victim.seq_id, victim.deadline or float("inf"), cand,
+                        )
+                        self._preempt(victim)
+                self._admit()
+            except Exception as e:
+                # admission must never kill the loop (e.g. device state
+                # mid-rebuild-failure): log, back off, keep serving what
+                # still runs
+                logger.error("admission error: %s", e)
+                await asyncio.sleep(0.05)
 
             prefill_active = bool(self._prefix_jobs) or self._prefill_work()
             # label for the inter-token histogram, and the denominator for
@@ -1552,19 +2019,19 @@ class ContinuousBatchingScheduler:
                 # iteration — the prefill side was synchronous in the split
                 # path too): drain any pipelined split-path leftover first
                 if inflight is not None:
-                    await self._consume_inflight(inflight)
-                    inflight = None
+                    inflight = await self._drain_inflight(inflight)
                 if self._use_mixed():  # consuming may have evicted slots
                     try:
                         await self._mixed_round()
+                        self._note_round_ok("decode")
+                        self._note_round_ok("prefill")
                     except Exception as e:
-                        # not attributable to one sequence: fail the
-                        # round's prefill rows AND the decode members that
-                        # rode the same dispatch, keep serving
+                        # not attributable to one sequence: the round's
+                        # prefill rows AND decode members rode the same
+                        # dispatch — recover them together (preempt/replay
+                        # under the breaker, legacy eviction without it)
                         logger.error("mixed step error: %s", e)
-                        self._fail_prefill_round(str(e))
-                        for handle in list(self.decoding.values()):
-                            self._evict(handle, "error", error=str(e))
+                        self._round_failed("mixed", str(e))
                     await asyncio.sleep(0)  # let producers/consumers run
                     continue
 
@@ -1574,9 +2041,10 @@ class ContinuousBatchingScheduler:
             if self.prefilling or self._prefix_jobs:
                 try:
                     await self._prefill_round()
+                    self._note_round_ok("prefill")
                 except Exception as e:
                     logger.error("prefill round error: %s", e)
-                    self._fail_prefill_round(str(e))
+                    self._round_failed("prefill", str(e))
 
             if (
                 self.decoding and self.spec_k > 0
@@ -1591,11 +2059,11 @@ class ContinuousBatchingScheduler:
                         await self._consume_inflight(inflight)
                         inflight = None
                     await self._run_spec_step()
+                    self._note_round_ok("decode")
                 except Exception as e:
                     logger.error("spec decode step error: %s", e)
                     inflight = None
-                    for handle in list(self.decoding.values()):
-                        self._evict(handle, "error", error=str(e))
+                    self._round_failed("spec", str(e))
             elif self.decoding:
                 try:
                     # a grammar-constrained slot's next input comes from a
@@ -1604,6 +2072,12 @@ class ContinuousBatchingScheduler:
                     # before that consume (it rejoins the following one,
                     # advancing every other step). Unconstrained slots keep
                     # the full depth-2 cadence throughout (verdict r3 #6).
+                    # a decode round counts OK only when a consume actually
+                    # succeeded: dispatch-only iterations (inflight was None
+                    # right after a failure) must not reset the streak, or a
+                    # device whose errors surface at the host FETCH would
+                    # oscillate the streak 0↔1 and never trip the breaker
+                    consumed = False
                     pending = self._pending_constrained(inflight) if inflight is not None else set()
                     ahead = self._undelivered(inflight)
                     use_loop = self.loop_depth > 1 and any(
@@ -1621,6 +2095,7 @@ class ContinuousBatchingScheduler:
                         blk = self._dispatch_decode_loop(exclude=pending, ahead=ahead)
                         if inflight is not None:
                             await self._consume_inflight(inflight)
+                            consumed = True
                         inflight = blk
                     elif any(slot not in pending for slot in self.decoding):
                         # depth-2 pipeline: dispatch N+1 (sans pending
@@ -1629,6 +2104,7 @@ class ContinuousBatchingScheduler:
                         step = self._dispatch_decode(exclude=pending)
                         if inflight is not None:
                             await self._consume_inflight(inflight)
+                            consumed = True
                         inflight = step
                     else:
                         # every decoding slot is waiting on a host pick:
@@ -1636,18 +2112,24 @@ class ContinuousBatchingScheduler:
                         if inflight is not None:
                             await self._consume_inflight(inflight)
                             inflight = None
+                            consumed = True
                         if self.decoding:
                             await self._consume_step(self._dispatch_decode())
+                            consumed = True
+                    if consumed:
+                        self._note_round_ok("decode")
                 except Exception as e:
                     # a whole-batch failure is not attributable to one
-                    # sequence: fail all in-flight decodes, keep serving
+                    # sequence: recover all in-flight decodes together
+                    # (preempt/replay under the breaker, legacy eviction
+                    # without it), keep serving. The dropped in-flight
+                    # dispatch is never re-consumed — it may be partially
+                    # delivered, and replay recomputes the rest anyway.
                     logger.error("decode step error: %s", e)
                     inflight = None
-                    for handle in list(self.decoding.values()):
-                        self._evict(handle, "error", error=str(e))
+                    self._round_failed("decode", str(e))
             elif inflight is not None:
-                await self._consume_inflight(inflight)
-                inflight = None
+                inflight = await self._drain_inflight(inflight)
 
             await asyncio.sleep(0)  # let producers/consumers run
         logger.info("scheduler loop stopped")

@@ -12,9 +12,14 @@ Two backends:
 - ``InMemoryBroker``: an in-process broker with real Kafka semantics —
   partitions, key → partition hashing (so a conversation's chunks stay
   ordered, reference main.py:96), consumer groups with partition assignment
-  and committed offsets. Default when librdkafka isn't installed; also the
-  test/fault-injection harness (SURVEY §5.3: the reference has no fault
-  injection — this adds drop/delay/poison hooks).
+  and committed offsets, producer timestamps, and (``kafka.
+  commit_after_process``) manual-commit positions: poll advances the
+  consumption position while the committed offset moves only at
+  ``commit_offset``, so a crash mid-message redelivers it when the group
+  re-forms (at-least-once; default off = reference at-most-once parity).
+  Default when librdkafka isn't installed; also the test/fault-injection
+  harness (SURVEY §5.3: the reference has no fault injection — this adds
+  drop/delay/poison hooks).
 - confluent-kafka (librdkafka), used when ``kafka.backend == "confluent"``.
 """
 
@@ -22,6 +27,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 import uuid
 import zlib
 from dataclasses import dataclass, field
@@ -46,12 +52,14 @@ class Message:
     """Consumer record with the confluent-kafka ``Message`` read surface the
     app uses: ``value()`` / ``key()`` / ``topic()`` / ``error()``."""
 
-    def __init__(self, topic: str, key: str | None, value: bytes, offset: int = -1, partition: int = 0):
+    def __init__(self, topic: str, key: str | None, value: bytes, offset: int = -1,
+                 partition: int = 0, timestamp_ms: int | None = None):
         self._topic = topic
         self._key = key
         self._value = value
         self._offset = offset
         self._partition = partition
+        self._timestamp_ms = int(time.time() * 1000) if timestamp_ms is None else timestamp_ms
 
     def value(self) -> bytes:
         return self._value
@@ -69,6 +77,14 @@ class Message:
 
     def partition(self) -> int:
         return self._partition
+
+    def timestamp(self) -> tuple[int, int]:
+        """(timestamp_type, ms) matching librdkafka's Message.timestamp()
+        — type 1 is TIMESTAMP_CREATE_TIME (producer-stamped). The serving
+        layer anchors per-request deadlines here (message arrival + the
+        configured allowance), so queueing time counts against the
+        deadline the way a client experiences it."""
+        return (1, self._timestamp_ms)
 
     def error(self) -> None:
         return None
@@ -91,7 +107,14 @@ class _GroupState:
     def __init__(self) -> None:
         self.members: list[str] = []
         self.subscriptions: dict[str, list[str]] = {}  # member -> topics
+        # COMMITTED offsets — what a (re)joining consumer resumes from
         self.offsets: dict[tuple[str, int], int] = {}  # (topic, partition) -> next offset
+        # consumption positions — where poll reads next. Auto-commit mode
+        # keeps them locked to ``offsets``; manual-commit mode (at-least-
+        # once, kafka.commit_after_process) advances positions at poll but
+        # offsets only at commit, so a consumer that crashes mid-message
+        # redelivers everything uncommitted when the group re-forms.
+        self.positions: dict[tuple[str, int], int] = {}
 
 
 class InMemoryBroker:
@@ -134,6 +157,10 @@ class InMemoryBroker:
                     tp = (topic, part)
                     if tp not in group.offsets:
                         group.offsets[tp] = len(log.records) if offset_reset == "latest" else 0
+                    # a (re)join rewinds the position to the committed
+                    # offset — the rebalance semantics that make manual
+                    # commit at-least-once (uncommitted records redeliver)
+                    group.positions[tp] = group.offsets[tp]
 
     def leave_group(self, group_id: str, member_id: str) -> None:
         with self._lock:
@@ -158,18 +185,33 @@ class InMemoryBroker:
                     out.append((topic, part))
         return out
 
-    def poll(self, group_id: str, member_id: str, topics: list[str]) -> Message | None:
+    def poll(self, group_id: str, member_id: str, topics: list[str],
+             auto_commit: bool = True) -> Message | None:
         with self._lock:
             group = self._groups.get(group_id)
             if group is None or member_id not in group.members:
                 return None
             for topic, part in self._assignment(group, member_id, topics):
                 log = self._topics[topic][part]
-                offset = group.offsets.get((topic, part), 0)
-                if offset < len(log.records):
-                    group.offsets[(topic, part)] = offset + 1  # auto-commit (at-most-once)
-                    return log.records[offset]
+                tp = (topic, part)
+                pos = group.positions.get(tp, group.offsets.get(tp, 0))
+                if pos < len(log.records):
+                    group.positions[tp] = pos + 1
+                    if auto_commit:  # at-most-once (reference parity)
+                        group.offsets[tp] = pos + 1
+                    return log.records[pos]
             return None
+
+    def commit(self, group_id: str, topic: str, partition: int, next_offset: int) -> None:
+        """Commit ``next_offset`` as the resume point for a partition
+        (manual-commit mode). Monotonic: a late commit for an earlier
+        offset never rewinds a later one."""
+        with self._lock:
+            group = self._groups.get(group_id)
+            if group is None:
+                return
+            tp = (topic, partition)
+            group.offsets[tp] = max(group.offsets.get(tp, 0), next_offset)
 
     # --- test/introspection helpers -------------------------------------
     def drain(self, topic: str) -> list[Message]:
@@ -202,6 +244,10 @@ class KafkaClient:
         self._consumer_ready = False
         self._topics: list[str] = []
         self._member_id = f"member-{uuid.uuid4().hex[:12]}"
+        # at-least-once: poll does NOT advance the committed offset; the
+        # app calls commit_message after the watchdog-wrapped handler
+        # completes (serve/app.py)
+        self._manual_commit = bool(self.config.commit_after_process)
 
         if self.config.backend == "confluent":
             if not HAVE_CONFLUENT:
@@ -227,6 +273,8 @@ class KafkaClient:
                 "group.id": GROUP_ID,
                 "auto.offset.reset": self.config.auto_offset_reset,
             }
+            if self._manual_commit:
+                consumer_config["enable.auto.commit"] = "false"
             self._consumer = confluent_kafka.Consumer(consumer_config)
             self._consumer.subscribe(self._topics)
         self._consumer_ready = True
@@ -238,7 +286,10 @@ class KafkaClient:
             return None
         try:
             if self._broker is not None:
-                return self._broker.poll(GROUP_ID, self._member_id, self._topics)
+                return self._broker.poll(
+                    GROUP_ID, self._member_id, self._topics,
+                    auto_commit=not self._manual_commit,
+                )
             msg = self._consumer.poll(0.1)  # pragma: no cover
             if msg is None or msg.error():
                 if msg is not None:
@@ -248,6 +299,23 @@ class KafkaClient:
         except Exception as e:
             logger.error("Error in message consumption: %s", e)
             return None
+
+    def commit_offset(self, topic: str, partition: int, next_offset: int) -> None:
+        """Commit a partition's resume offset (manual-commit mode; no-op
+        otherwise). The app calls this with its contiguous-completion
+        watermark — never a bare message offset, which would implicitly
+        commit every EARLIER in-flight message on the partition too
+        (serve/app.py _note_message_done)."""
+        if not self._manual_commit:
+            return
+        if self._broker is not None:
+            self._broker.commit(GROUP_ID, topic, partition, next_offset)
+        elif self._consumer is not None:  # pragma: no cover - needs librdkafka
+            self._consumer.commit(
+                offsets=[confluent_kafka.TopicPartition(topic, partition, next_offset)],
+                asynchronous=False,
+            )
+        METRICS.inc("finchat_kafka_commits_total")
 
     # --- producer -------------------------------------------------------
     def _produce_raw(self, topic: str, key: str, value: dict[str, Any]) -> None:

@@ -80,16 +80,25 @@ def plot_chunk(message_value: dict[str, Any], data_uri: str) -> dict[str, Any]:
     }
 
 
-def error_chunk(message_value: dict[str, Any]) -> dict[str, Any]:
+def error_chunk(message_value: dict[str, Any], *, code: str | None = None,
+                retryable: bool | None = None) -> dict[str, Any]:
     """Error marker (reference main.py:114-120). Intentionally has NO
-    ``type`` field and an empty ``message``."""
-    return {
+    ``type`` field and an empty ``message``. ``code``/``retryable`` are
+    ADDITIVE fields for structured failures (deadline shed, overload —
+    ROBUSTNESS.md): present only when supplied, so the default shape stays
+    byte-for-byte reference-compatible and unaware consumers ignore them."""
+    chunk = {
         **message_value,
         "message": "",
         "last_message": True,
         "error": True,
         "sender": AI_SENDER,
     }
+    if code is not None:
+        chunk["code"] = code
+    if retryable is not None:
+        chunk["retryable"] = retryable
+    return chunk
 
 
 def timeout_chunk(message_value: dict[str, Any]) -> dict[str, Any]:

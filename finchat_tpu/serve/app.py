@@ -33,6 +33,8 @@ from __future__ import annotations
 
 import asyncio
 import json
+import time
+from collections import deque
 from pathlib import Path
 
 import jax
@@ -326,6 +328,17 @@ class App:
         self._registered_heads: set[str] = set()
         self._prefix_refresh_check_s = 60.0
         self._prefix_refresh_task: asyncio.Task | None = None
+        # at-least-once bookkeeping (kafka.commit_after_process): offsets
+        # commit only at the CONTIGUOUS-completion watermark per partition
+        # — committing a bare message offset would implicitly commit every
+        # earlier message still in flight on that partition — plus a
+        # bounded message_id dedupe ring so redelivery after a crash
+        # doesn't double-answer a conversation
+        self._commit_enabled = cfg.kafka.commit_after_process
+        self._done_offsets: dict[tuple[str, int], set[int]] = {}
+        self._commit_next: dict[tuple[str, int], int] = {}
+        self._seen_ids: set = set()
+        self._seen_ring: deque = deque()
 
     # --- lifespan -------------------------------------------------------
     def _embed_batcher(self):
@@ -348,6 +361,8 @@ class App:
             topics.append(TRANSACTION_UPSERT_TOPIC)
         self.kafka.setup_consumer(topics=topics)
         if self.scheduler is not None:
+            if self._on_engine_rebuild not in self.scheduler.on_rebuild:
+                self.scheduler.on_rebuild.append(self._on_engine_rebuild)
             await self.scheduler.start()
         self._running = True
         self._consume_task = asyncio.create_task(self.consume_messages())
@@ -404,6 +419,82 @@ class App:
         except Exception as e:
             logger.error("failed to persist vector index: %s", e)
 
+    def _on_engine_rebuild(self) -> None:
+        """Scheduler breaker trip rebuilt the engine's device state: the
+        shared prompt heads' prefilled KV is gone with it. Mark them
+        unregistered so the periodic prefix-refresh loop re-registers them
+        through the chunked path — recovery itself never stalls on a
+        multi-second head prefill."""
+        self._registered_heads = set()
+
+    def _request_deadline(self, wall_anchor_s: float | None = None) -> float | None:
+        """Per-request deadline on the scheduler's monotonic clock, or
+        None when ``engine.request_deadline_seconds`` is unset. Anchored at
+        the Kafka message's producer timestamp when given (broker queueing
+        time counts against the allowance, exactly as the waiting client
+        experiences it) or at arrival for the HTTP paths."""
+        allowance = self.cfg.engine.request_deadline_seconds
+        if allowance <= 0:
+            return None
+        now_wall = time.time()
+        anchor = now_wall if wall_anchor_s is None else wall_anchor_s
+        return time.perf_counter() + (anchor - now_wall) + allowance
+
+    @staticmethod
+    def _message_wall_ts(message) -> float | None:
+        """Producer wall-clock seconds from a Kafka message, if stamped."""
+        try:
+            ts_type, ts_ms = message.timestamp()
+        except Exception:
+            return None
+        if ts_type == 0 or ts_ms is None or ts_ms <= 0:
+            return None
+        return ts_ms / 1000.0
+
+    # --- at-least-once commit plumbing (kafka.commit_after_process) ------
+    DEDUPE_RING_SIZE = 1024
+
+    def _note_message_polled(self, msg) -> None:
+        """Anchor the partition's commit watermark at the FIRST polled
+        offset (poll order is offset order per partition)."""
+        if not self._commit_enabled or msg.offset() < 0:
+            return
+        self._commit_next.setdefault((msg.topic(), msg.partition()), msg.offset())
+
+    def _note_message_done(self, msg) -> None:
+        """A message's watchdog-wrapped handling completed (answered,
+        errored, timed out, or deduped — all terminal): advance the
+        partition's contiguous-completion watermark and commit it."""
+        if not self._commit_enabled or msg.offset() < 0:
+            return
+        tp = (msg.topic(), msg.partition())
+        done = self._done_offsets.setdefault(tp, set())
+        done.add(msg.offset())
+        nxt = self._commit_next.setdefault(tp, msg.offset())
+        advanced = False
+        while nxt in done:
+            done.discard(nxt)
+            nxt += 1
+            advanced = True
+        if advanced:
+            self._commit_next[tp] = nxt
+            try:
+                self.kafka.commit_offset(tp[0], tp[1], nxt)
+            except Exception as e:
+                logger.error("offset commit failed for %s: %s", tp, e)
+
+    def _seen_message_id(self, message_id) -> bool:
+        """Bounded dedupe ring over inbound ``message_id``s: True when this
+        id was already handled this process lifetime (redelivery after a
+        crash/rebalance must not double-answer)."""
+        if message_id in self._seen_ids:
+            return True
+        self._seen_ids.add(message_id)
+        self._seen_ring.append(message_id)
+        if len(self._seen_ring) > self.DEDUPE_RING_SIZE:
+            self._seen_ids.discard(self._seen_ring.popleft())
+        return False
+
     # --- conversation plumbing ------------------------------------------
     @staticmethod
     def _payload_error(payload: dict) -> Response | None:
@@ -453,6 +544,7 @@ class App:
         result = await self.agent.query(
             payload["message"], user_id, user_context, chat_history,
             conversation_id=conversation_id,
+            deadline=self._request_deadline(),
         )
         body = {
             "response": result["response"],
@@ -472,10 +564,12 @@ class App:
             await self._conversation_inputs(payload)
         )
 
+        deadline = self._request_deadline()
+
         async def events():
             updates = self.agent.stream_with_status(
                 payload["message"], user_id, user_context, chat_history,
-                conversation_id=conversation_id,
+                conversation_id=conversation_id, deadline=deadline,
             )
             # decode_loop bursts re-pace through the SAME per-chunk emit —
             # clients see a smooth token cadence, not K-frame stutters
@@ -525,7 +619,12 @@ class App:
         return len(texts)
 
     # --- Kafka worker loop ----------------------------------------------
-    async def process_message(self, message, message_value: dict | None = None) -> None:
+    async def process_message(self, message, message_value: dict | None = None) -> bool:
+        """Handle one user message end-to-end. Returns True only when the
+        client was ANSWERED (stream completed); False for drops, errors,
+        and sheds — the dedupe ring keeps only answered message_ids, so a
+        producer retrying a failed/shed message (as the retryable error
+        chunk invites) is reprocessed, never black-holed."""
         if message_value is None:
             message_value = json.loads(message.value().decode("utf-8"))
         msg = message_value["message"]
@@ -539,7 +638,7 @@ class App:
             )
         except Exception as e:
             logger.error("Error retrieving context or history for conversation %s: %s", conversation_id, e)
-            return
+            return False
 
         # stream_flush_tokens > 1 coalesces N model chunks into one outbound
         # Kafka produce — fewer, larger messages for high-throughput topics
@@ -556,10 +655,16 @@ class App:
                 )
                 logger.debug("Processed chunk: %s", text)
 
+        # deadline anchored at the PRODUCER timestamp: broker queueing time
+        # counts against the allowance, so a message that sat through a
+        # backlog sheds (structured retryable error) instead of burning
+        # prefill compute on an answer its client gave up on
+        updates = self.agent.stream_with_status(
+            msg, user_id, context, chat_history, conversation_id=conversation_id,
+            deadline=self._request_deadline(self._message_wall_ts(message)),
+        )
         try:
-            async for update in self.agent.stream_with_status(
-                msg, user_id, context, chat_history, conversation_id=conversation_id
-            ):
+            async for update in updates:
                 if update["type"] == "response_chunk":
                     chunk_text = update["content"]
                     full_message += chunk_text
@@ -588,16 +693,31 @@ class App:
                 flush_pending()
             except Exception:
                 pass
+            # structured failures (deadline shed, overload) carry their
+            # code + retryable flag so the producer can back off and
+            # retry; ordinary errors keep the reference's exact shape
             self.kafka.produce_error_message(
-                AI_RESPONSE_TOPIC, conversation_id, error_chunk(message_value)
+                AI_RESPONSE_TOPIC, conversation_id,
+                error_chunk(
+                    message_value,
+                    code=getattr(e, "code", None),
+                    retryable=True if getattr(e, "retryable", False) else None,
+                ),
             )
-            return
+            return False
+        finally:
+            # guarantee generator finalization: the engine handle's
+            # slot/KV release lives in the generator's finally, which a
+            # consumer cancelled OUTSIDE __anext__ (watchdog timeout)
+            # would otherwise leave to the GC
+            await updates.aclose()
 
         try:
             await self.store.save_ai_message(conversation_id=conversation_id, message=full_message, user_id=user_id)
             logger.info("Message saved to DB for conversation %s", conversation_id)
         except Exception as e:
             logger.error("Error saving AI message to DB: %s", e)
+        return True
 
     async def process_upsert(self, message) -> None:
         """transaction_upsert topic: same body as POST /transactions."""
@@ -612,22 +732,35 @@ class App:
 
     async def _process_with_watchdog(
         self, msg, message_value: dict | None, prev: asyncio.Task | None
-    ) -> None:
+    ) -> bool:
         """One in-flight message: wait for the SAME conversation's previous
         message to finish (chunk-ordering guarantee), then run under the
-        per-message watchdog (reference main.py:138-153 semantics)."""
+        per-message watchdog (reference main.py:138-153 semantics).
+        Returns process_message's answered flag (False on timeout/error)
+        — what decides whether the message_id stays in the dedupe ring."""
         if prev is not None:
             try:
                 await asyncio.shield(prev)
             except Exception:
                 pass  # predecessor's failure was already reported on its stream
         watchdog = self.cfg.engine.watchdog_seconds
+        task = asyncio.create_task(self.process_message(msg, message_value))
         try:
-            await asyncio.wait_for(
-                self.process_message(msg, message_value), timeout=watchdog
-            )
+            return bool(await asyncio.wait_for(asyncio.shield(task), timeout=watchdog))
         except asyncio.TimeoutError:
             logger.error("Message processing timed out after %s seconds", watchdog)
+            # cancel the in-flight generation and AWAIT its cleanup — the
+            # agent/generator finalizers release the scheduler slot and KV
+            # pages — BEFORE emitting the timeout chunk, so a timed-out
+            # message can never leak engine capacity (the pre-fix path
+            # abandoned the coroutine to wait_for's cancellation and raced
+            # the chunk against the release; tests/test_resilience.py pins
+            # zero slot/page leakage)
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
             try:
                 if message_value is not None:
                     self.kafka.produce_error_message(
@@ -637,10 +770,17 @@ class App:
                     )
             except Exception as e:
                 logger.error("Failed to send timeout error message: %s", e)
+            return False
         except asyncio.CancelledError:
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
             raise
         except Exception as e:
             logger.error("Error processing message: %s", e)
+            return False
 
     def _spawn_message_task(self, msg) -> None:
         # parse ONCE here; process_message / the timeout path reuse the dict
@@ -650,16 +790,51 @@ class App:
         except Exception:
             message_value = None  # malformed: process_message reports it
             conv_id = ""
+        mid = None
+        if self._commit_enabled and message_value is not None:
+            # redelivery dedupe (at-least-once): a message_id this process
+            # already ANSWERED (or holds in flight) is not re-answered —
+            # its offset still counts as done so the watermark (and the
+            # group) can move past it. Ids whose handling FAILS are
+            # removed from the ring in _done below, so a producer retrying
+            # a shed/overloaded/timed-out message is reprocessed.
+            mid = message_value.get("message_id")
+            if mid is not None and self._seen_message_id(mid):
+                METRICS.inc("finchat_kafka_dedupe_skips_total")
+                logger.warning(
+                    "duplicate message_id %s (redelivery); already answered, skipping",
+                    mid,
+                )
+                self._note_message_done(msg)
+                return
         prev = self._conv_tails.get(conv_id)
         task = asyncio.create_task(self._process_with_watchdog(msg, message_value, prev))
         self._inflight.add(task)
         if conv_id:
             self._conv_tails[conv_id] = task
 
-        def _done(t: asyncio.Task, conv_id=conv_id) -> None:
+        def _done(t: asyncio.Task, conv_id=conv_id, mid=mid) -> None:
             self._inflight.discard(t)
             if conv_id and self._conv_tails.get(conv_id) is t:
                 del self._conv_tails[conv_id]
+            answered = (
+                not t.cancelled() and t.exception() is None and bool(t.result())
+            )
+            if mid is not None and not answered:
+                # never answered: drop the id so a producer retry (the
+                # retryable error chunk's invitation) is reprocessed. The
+                # ring entry goes too — a stale duplicate left in the deque
+                # would, on overflow, discard the RE-ADDED (answered) id
+                # from the set long before 1024 newer ids passed
+                self._seen_ids.discard(mid)
+                try:
+                    self._seen_ring.remove(mid)
+                except ValueError:
+                    pass
+            # the watchdog-wrapped handler completed (answered, errored, or
+            # timed out with the timeout chunk emitted): only now may this
+            # offset count toward the committed watermark
+            self._note_message_done(msg)
 
         task.add_done_callback(_done)
 
@@ -683,11 +858,15 @@ class App:
                 # blocks up to 100 ms (librdkafka), which would stall every
                 # in-flight stream now that polling overlaps processing
                 msg = await asyncio.to_thread(self.kafka.poll_message)
+                if msg is not None:
+                    self._note_message_polled(msg)
                 if msg is not None and msg.topic() == TRANSACTION_UPSERT_TOPIC:
                     try:
                         await self.process_upsert(msg)
                     except Exception as e:
                         logger.error("Error ingesting transactions: %s", e)
+                    finally:
+                        self._note_message_done(msg)
                 elif msg is not None:
                     self._spawn_message_task(msg)
                     await asyncio.sleep(0)  # let the new task reach the engine

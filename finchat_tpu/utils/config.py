@@ -72,6 +72,15 @@ class KafkaConfig:
     auto_offset_reset: str = "latest"
     # "memory" = in-process broker (tests/dev); "confluent" = librdkafka.
     backend: str = "memory"
+    # at-least-once delivery (default off = reference at-most-once parity):
+    # disable poll-time auto-commit and commit offsets only AFTER the
+    # watchdog-wrapped handler completes, so a worker crash mid-message
+    # redelivers it to the group instead of silently losing it. The app
+    # pairs this with an in-memory per-message_id dedupe ring so
+    # SAME-PROCESS redelivery (rebalance, producer retry) doesn't
+    # double-answer; redelivery after a full crash may re-answer — the
+    # standard at-least-once trade (serve/app.py).
+    commit_after_process: bool = False
 
     def librdkafka_config(self) -> dict[str, str]:
         """Render the confluent-kafka config dict, including the SASL_SSL ↔
@@ -243,6 +252,43 @@ class EngineConfig:
     # restarted process reloads them instead of re-paying full XLA
     # compilation; "" = off (JAX default behavior)
     compilation_cache_dir: str = ""
+    # --- resilience plane (engine/scheduler; see ROBUSTNESS.md) ---------
+    # engine circuit breaker: this many CONSECUTIVE failed dispatch rounds
+    # (whole-round prefill/decode/mixed/spec failures — not per-sequence
+    # faults) trips the breaker: every live sequence is recompute-preempted
+    # to host, the engine's device state (KV pool, page table, slots) is
+    # torn down and rebuilt with weights retained, and a half-open probe
+    # round re-admits via the recompute path. Below the threshold, a failed
+    # round preempts its sequences and replays them — a transient blip
+    # costs a re-prefill, not the stream. 0 = breaker off (legacy behavior:
+    # a whole-round failure evicts its in-flight sequences with an error).
+    breaker_threshold: int = 3
+    # consecutive rebuilds WITHOUT an intervening successful round before
+    # the breaker gives up and fails the in-flight streams (a persistently
+    # wedged engine must not rebuild-loop forever)
+    breaker_max_rebuilds: int = 2
+    # recompute preemption under page pressure: when the earliest-deadline
+    # pending request stalls on KV pages, preempt the latest-deadline
+    # decoding victim(s) whose deadline is STRICTLY later (prompt +
+    # generated tokens are kept on the handle; re-admission re-prefills and
+    # resumes with zero duplicate or dropped tokens). Deadline order makes
+    # the policy livelock-free. False = legacy head-of-line wait.
+    preemption: bool = True
+    # per-request deadline seconds (Kafka message timestamp + this, or HTTP
+    # arrival + this): pending requests past their deadline are shed
+    # pre-admission with a structured retryable error chunk, and admission
+    # orders earliest-deadline-first. 0 = no deadlines (legacy FIFO).
+    request_deadline_seconds: float = 0.0
+    # EDF starvation guard: a pending request that has waited this long is
+    # admitted ahead of deadline order (FIFO among the starved), so a
+    # stream of tight-deadline arrivals cannot starve a deadline-less or
+    # far-deadline request forever
+    edf_starvation_seconds: float = 10.0
+    # admission queue bound: submit() rejects with a retryable overload
+    # error once this many requests are pending (backpressure instead of
+    # an unbounded queue). 0 = unbounded (legacy). Preempted sequences
+    # re-enter pending regardless — they are live streams, not new load.
+    max_queue_depth: int = 0
     # chunked ring prefill: segment size (tokens) for the seq-sharded
     # prefill. > 0 splits a ring-eligible prompt into segments that
     # interleave with decode steps in the scheduler loop (each segment
@@ -337,6 +383,9 @@ def load_config(
 
     # --- env (new framework surface) ---
     cfg.kafka.backend = _env("FINCHAT_KAFKA_BACKEND", cfg.kafka.backend)
+    cfg.kafka.commit_after_process = _env_bool(
+        "FINCHAT_KAFKA_COMMIT_AFTER_PROCESS", cfg.kafka.commit_after_process
+    )
     cfg.store.backend = _env("FINCHAT_STORE_BACKEND", cfg.store.backend)
     cfg.vector.persist_path = _env("FINCHAT_VECTOR_PERSIST", cfg.vector.persist_path)
     cfg.model.preset = _env("FINCHAT_MODEL_PRESET", cfg.model.preset)
@@ -374,6 +423,19 @@ def load_config(
     cfg.engine.mixed_step = _env_bool("FINCHAT_MIXED_STEP", cfg.engine.mixed_step)
     cfg.engine.compilation_cache_dir = _env(
         "FINCHAT_COMPILATION_CACHE_DIR", cfg.engine.compilation_cache_dir
+    )
+    cfg.engine.breaker_threshold = _env_int(
+        "FINCHAT_BREAKER_THRESHOLD", cfg.engine.breaker_threshold
+    )
+    cfg.engine.breaker_max_rebuilds = _env_int(
+        "FINCHAT_BREAKER_MAX_REBUILDS", cfg.engine.breaker_max_rebuilds
+    )
+    cfg.engine.preemption = _env_bool("FINCHAT_PREEMPTION", cfg.engine.preemption)
+    cfg.engine.request_deadline_seconds = _env_float(
+        "FINCHAT_REQUEST_DEADLINE_SECONDS", cfg.engine.request_deadline_seconds
+    )
+    cfg.engine.max_queue_depth = _env_int(
+        "FINCHAT_MAX_QUEUE_DEPTH", cfg.engine.max_queue_depth
     )
     cfg.serve.port = _env_int("FINCHAT_PORT", cfg.serve.port)
 

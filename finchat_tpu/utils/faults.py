@@ -76,6 +76,34 @@ def one_shot(exc: Exception) -> Handler:
     return handler
 
 
+def n_shot(n: int, exc: Exception) -> Handler:
+    """Handler that raises ``exc`` exactly ``n`` times, then passes —
+    models a bounded outage (the circuit breaker's consecutive-failure
+    threshold is exactly this shape)."""
+    remaining = [n]
+
+    def handler(**_ctx: Any) -> None:
+        if remaining[0] > 0:
+            remaining[0] -= 1
+            raise exc
+
+    return handler
+
+
+def flaky(rate: float, exc: Exception, seed: int = 0) -> Handler:
+    """Handler that raises ``exc`` with probability ``rate`` per call —
+    the chaos-sweep fault model (bench.py --chaos-sweep)."""
+    import random
+
+    rng = random.Random(seed)
+
+    def handler(**_ctx: Any) -> None:
+        if rng.random() < rate:
+            raise exc
+
+    return handler
+
+
 def for_seq(seq_id: str, exc: Exception) -> Handler:
     """Handler that raises only for one victim sequence (ctx['seq_id'])."""
 

@@ -36,6 +36,22 @@ gaps LABELED by ``prefill_concurrent`` ("yes" when the emitting iteration
 also ran prefill work, "no" for steady decode), the instrument that makes
 the mixed step's admission-stall win visible in Prometheus.
 
+Resilience family (scheduler preemption/breaker/deadline plane, ISSUE 5 —
+ROBUSTNESS.md): ``finchat_preemptions_total`` (recompute preemptions —
+page-pressure victims plus breaker recovery; each keeps prompt+generated
+on the handle and replays through admission), ``finchat_sheds_total``
+(pending requests shed past their deadline with a structured retryable
+error), ``finchat_overload_rejections_total`` (submits rejected at
+``max_queue_depth``), ``finchat_dispatch_failures_total`` (whole-round
+dispatch failures feeding the breaker streaks),
+``finchat_engine_rebuilds_total`` (breaker trips that tore down and
+rebuilt device state), ``finchat_breaker_state`` (gauge: 0 closed, 1 open/
+rebuilding, 2 half-open awaiting the probe round), and the recovery-
+latency histograms ``finchat_engine_rebuild_seconds`` (teardown→rebuilt)
+and ``finchat_breaker_recovery_seconds`` (trip → first successful round).
+``finchat_kafka_commits_total`` / ``finchat_kafka_dedupe_skips_total``
+instrument the at-least-once option (kafka.commit_after_process).
+
 Retrieval-plane family (embed/batcher.py microbatcher, embed/index.py
 batched search, agent/scheduler overlap):
 ``finchat_embed_batch_occupancy`` (gauge — texts in the last coalesced

@@ -244,6 +244,79 @@ async def test_kafka_conversations_process_concurrently():
         await app.stop()
 
 
+async def test_commit_after_process_and_dedupe_ring():
+    """kafka.commit_after_process (at-least-once): offsets commit only
+    after the watchdog-wrapped handler completes, and a redelivered
+    message_id is answered exactly once (dedupe ring)."""
+    from finchat_tpu.utils.config import GROUP_ID
+    from finchat_tpu.utils.metrics import METRICS
+
+    app, broker, _ = make_app(response_text="Once only.")
+    app.cfg.kafka.commit_after_process = True
+    app.kafka._manual_commit = True  # client was built before the override
+    app._commit_enabled = True
+    await app.start(serve_http=False)
+    try:
+        d0 = METRICS.get("finchat_kafka_dedupe_skips_total")
+        producer = KafkaClient(app.cfg.kafka, broker=broker)
+        payload = inbound(message_id="m-1")
+        producer.produce_message(USER_MESSAGE_TOPIC, "c1", payload)
+        producer.produce_message(USER_MESSAGE_TOPIC, "c1", payload)  # redelivery
+        for _ in range(300):
+            out = drain_json(broker)
+            if sum(1 for e in out if e.get("type") == "complete") >= 1:
+                break
+            await asyncio.sleep(0.01)
+        await asyncio.sleep(0.1)  # let the duplicate poll + commit land
+        out = drain_json(broker)
+        completes = [e for e in out if e.get("type") == "complete"]
+        assert len(completes) == 1, f"duplicate message_id answered twice: {out}"
+        assert METRICS.get("finchat_kafka_dedupe_skips_total") == d0 + 1
+        # both offsets committed: the group's watermark moved past them
+        group = broker._groups[GROUP_ID]
+        committed = sum(
+            off for (topic, _p), off in group.offsets.items()
+            if topic == USER_MESSAGE_TOPIC
+        )
+        assert committed == 2, group.offsets
+    finally:
+        await app.stop()
+
+
+async def test_failed_message_id_is_retryable_not_deduped():
+    """Only ANSWERED message_ids stay in the dedupe ring: a message whose
+    handling failed (error chunk) leaves the ring, so the producer's retry
+    is reprocessed instead of black-holed."""
+    from finchat_tpu.utils.metrics import METRICS
+
+    app, broker, _ = make_app(fail_response=True)
+    app.cfg.kafka.commit_after_process = True
+    app.kafka._manual_commit = True
+    app._commit_enabled = True
+    await app.start(serve_http=False)
+    try:
+        producer = KafkaClient(app.cfg.kafka, broker=broker)
+        payload = inbound(message_id="m-fail")
+        producer.produce_message(USER_MESSAGE_TOPIC, "c1", payload)
+        for _ in range(300):
+            if drain_json(broker):
+                break
+            await asyncio.sleep(0.01)
+        await asyncio.sleep(0.05)  # let the done-callback run
+        assert drain_json(broker)[-1]["error"] is True
+        assert "m-fail" not in app._seen_ids, "failed id stuck in the dedupe ring"
+        d0 = METRICS.get("finchat_kafka_dedupe_skips_total")
+        producer.produce_message(USER_MESSAGE_TOPIC, "c1", payload)  # retry
+        for _ in range(300):
+            if len(drain_json(broker)) >= 2:
+                break
+            await asyncio.sleep(0.01)
+        assert len(drain_json(broker)) >= 2, "retry of a failed message was skipped"
+        assert METRICS.get("finchat_kafka_dedupe_skips_total") == d0
+    finally:
+        await app.stop()
+
+
 async def test_same_conversation_messages_stay_ordered():
     """Two messages for the SAME conversation must not interleave: the
     second's chunks start only after the first's complete marker (the

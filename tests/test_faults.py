@@ -23,13 +23,14 @@ def _clean_faults():
     faults.disarm_all()
 
 
-def _make_stack():
+def _make_stack(**cfg_overrides):
     tok = ByteTokenizer()
     config = PRESETS["tiny"]
     from finchat_tpu.utils.config import EngineConfig
 
     engine_cfg = EngineConfig(
-        max_seqs=2, page_size=8, num_pages=64, max_seq_len=128, prefill_chunk=16
+        max_seqs=2, page_size=8, num_pages=64, max_seq_len=128, prefill_chunk=16,
+        **cfg_overrides,
     )
     params = init_params(config, jax.random.key(0))
     engine = InferenceEngine(config, params, engine_cfg)
@@ -69,13 +70,44 @@ def test_prefill_fault_isolates_one_sequence():
     assert isinstance(ok_text, str)
 
 
-def test_transient_decode_fault_fails_inflight_then_recovers():
-    """A one-shot decode fault errors the in-flight batch (whole-batch
-    failure is not attributable to one sequence) but the NEXT request
-    succeeds — the engine recovers without restart."""
+def test_transient_decode_fault_absorbed_by_preempt_replay():
+    """ISSUE 5: with the breaker enabled (default), a one-shot decode
+    fault no longer fails the in-flight batch — the round's sequences are
+    recompute-preempted and replayed, so the stream COMPLETES, identical
+    to a fault-free run (greedy), with the preemption counted."""
+    from finchat_tpu.utils.metrics import METRICS
 
     async def run():
         _, scheduler, gen = _make_stack()
+        await scheduler.start()
+        sampling = SamplingParams(temperature=0.0, max_new_tokens=8)
+        try:
+            clean = await gen.generate("first request", sampling)
+            p0 = METRICS.get("finchat_preemptions_total")
+            faults.arm("scheduler.decode", faults.one_shot(RuntimeError("blip")))
+            text = await gen.generate("first request", sampling)
+            preempts = METRICS.get("finchat_preemptions_total") - p0
+        finally:
+            await scheduler.stop()
+        return clean, text, preempts
+
+    clean, text, preempts = asyncio.run(run())
+    assert text == clean, "preempt/replay changed the greedy stream"
+    assert preempts >= 1
+    # the fault never tripped the breaker (one blip < threshold)
+    from finchat_tpu.utils.metrics import METRICS
+
+    assert METRICS.get("finchat_breaker_state") == 0
+
+
+def test_transient_decode_fault_legacy_eviction_with_breaker_off():
+    """breaker_threshold=0 keeps the pre-ISSUE-5 contract: a one-shot
+    decode fault errors the in-flight batch (whole-batch failure is not
+    attributable to one sequence) but the NEXT request succeeds — the
+    engine recovers without restart."""
+
+    async def run():
+        _, scheduler, gen = _make_stack(breaker_threshold=0)
         await scheduler.start()
         sampling = SamplingParams(temperature=0.0, max_new_tokens=8)
         try:
@@ -111,6 +143,160 @@ def test_retrieval_fault_degrades_to_error_marker():
     assert result["response"].startswith("Here's")
     state = result["state"]
     assert state.retrieved_transactions == ["Error: vector index down"]
+
+
+async def _drain_tokens(handle):
+    """Collect a handle's token ids until done; raises on an error event."""
+    tokens = []
+    while True:
+        event = await handle.events.get()
+        if event["type"] == "token":
+            tokens.append(event["token_id"])
+        elif event["type"] == "done":
+            return tokens
+        else:
+            raise RuntimeError(event["message"])
+
+
+def test_mixed_round_fault_site_recovers_both_populations():
+    """New armable site ``scheduler.mixed`` (ISSUE 5 satellite): a fault in
+    the unified prefill+decode dispatch recovers BOTH the prefilling and
+    the decoding rows via preempt/replay — greedy streams byte-identical
+    to a fault-free run."""
+    import asyncio
+
+    from finchat_tpu.engine.sampler import SamplingParams
+
+    short = list(range(1, 13))
+    long = list(range(1, 49))  # 3 chunks at prefill_chunk=16
+
+    async def run(arm_fault: bool):
+        _, scheduler, _gen = _make_stack()
+        scheduler = ContinuousBatchingScheduler(scheduler.engine, eos_id=-1)
+        await scheduler.start()
+        sampling = SamplingParams(temperature=0.0, max_new_tokens=8)
+        try:
+            a = await scheduler.submit("a", short, sampling)
+            ta = asyncio.create_task(_drain_tokens(a))
+            while a.generated < 1:  # a is decoding before b admits
+                await asyncio.sleep(0.002)
+            if arm_fault:
+                faults.arm("scheduler.mixed", faults.one_shot(RuntimeError("mixed blip")))
+            b = await scheduler.submit("b", long, sampling)
+            out_b = await _drain_tokens(b)
+            out_a = await ta
+        finally:
+            await scheduler.stop()
+            faults.disarm_all()
+        return out_a, out_b
+
+    from finchat_tpu.utils.metrics import METRICS
+
+    clean = asyncio.run(run(False))
+    f0 = METRICS.get("finchat_dispatch_failures_total")
+    faulted = asyncio.run(run(True))
+    assert METRICS.get("finchat_dispatch_failures_total") > f0, (
+        "scheduler.mixed site never fired (mixed round did not run?)"
+    )
+    assert faulted == clean, "mixed-round fault recovery changed greedy streams"
+
+
+def test_embed_dispatch_fault_isolated_per_request_retry():
+    """New armable site ``embed.dispatch``: a failed coalesced embed
+    dispatch retries per-request, so every caller still resolves."""
+    import asyncio
+
+    import numpy as np
+
+    from finchat_tpu.embed.batcher import EmbedMicrobatcher
+    from finchat_tpu.utils.metrics import METRICS
+
+    class FakeEncoder:
+        dim = 4
+
+        def embed_batch(self, texts):
+            return np.ones((len(texts), self.dim), np.float32)
+
+    async def run():
+        batcher = EmbedMicrobatcher(FakeEncoder(), window_ms=5.0, max_batch=8)
+        faults.arm("embed.dispatch", faults.one_shot(RuntimeError("encoder down")))
+        try:
+            rows = await asyncio.gather(
+                *[batcher.embed_one(f"text {i}") for i in range(3)]
+            )
+        finally:
+            await batcher.close()
+        return rows
+
+    r0 = METRICS.get("finchat_embed_batch_retries_total")
+    rows = asyncio.run(run())
+    assert len(rows) == 3 and all(r.shape == (4,) for r in rows)
+    assert METRICS.get("finchat_embed_batch_retries_total") > r0, (
+        "coalesced-dispatch failure did not take the per-request retry path"
+    )
+
+
+def test_session_offload_fault_never_fails_retirement():
+    """New armable site ``session.offload``: a failed device→host snapshot
+    must not fail the retiring stream — the cache entry is simply not
+    stored (the cache is an optimization)."""
+    import asyncio
+
+    from finchat_tpu.engine.sampler import SamplingParams
+
+    async def run():
+        _, scheduler, _gen = _make_stack()
+        scheduler = ContinuousBatchingScheduler(scheduler.engine, eos_id=-1)
+        await scheduler.start()
+        try:
+            faults.arm("session.offload", faults.one_shot(RuntimeError("D2H failed")))
+            h = await scheduler.submit(
+                "t1", list(range(1, 20)),
+                SamplingParams(temperature=0.0, max_new_tokens=8),
+                conversation_id="conv-off",
+            )
+            tokens = await _drain_tokens(h)
+            entry = scheduler.session_cache.get("conv-off")
+        finally:
+            await scheduler.stop()
+        return tokens, entry
+
+    tokens, entry = asyncio.run(run())
+    assert len(tokens) == 8  # the stream completed normally
+    assert entry is None  # nothing cached — and nothing crashed
+
+
+def test_session_restore_fault_falls_back_to_cold_prefill():
+    """New armable site ``session.restore``: a failed host→device restore
+    at admission demotes to a cold start — the stream completes and the
+    allocator invariants hold (no leaked restore pages)."""
+    import asyncio
+
+    from finchat_tpu.engine.sampler import SamplingParams
+
+    async def run():
+        _, scheduler, _gen = _make_stack()
+        scheduler = ContinuousBatchingScheduler(scheduler.engine, eos_id=-1)
+        await scheduler.start()
+        sampling = SamplingParams(temperature=0.0, max_new_tokens=8)
+        try:
+            h1 = await scheduler.submit(
+                "t1", list(range(1, 20)), sampling, conversation_id="conv-res"
+            )
+            t1 = await _drain_tokens(h1)
+            assert scheduler.session_cache.get("conv-res") is not None
+            faults.arm("session.restore", faults.one_shot(RuntimeError("H2D failed")))
+            prompt2 = list(range(1, 20)) + t1 + list(range(30, 40))
+            h2 = await scheduler.submit(
+                "t2", prompt2, sampling, conversation_id="conv-res"
+            )
+            t2 = await _drain_tokens(h2)
+            scheduler.allocator.check_invariants()
+        finally:
+            await scheduler.stop()
+        return t2
+
+    assert len(asyncio.run(run())) == 8
 
 
 def test_kafka_drop_produce_is_silent_for_chunks():

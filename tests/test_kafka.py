@@ -78,6 +78,65 @@ def test_default_broker_is_shared_per_process():
     consumer.close()
 
 
+def test_manual_commit_redelivers_uncommitted_on_rejoin():
+    """kafka.commit_after_process (at-least-once): polled-but-uncommitted
+    records redeliver when the group re-forms (a crashed worker's
+    in-flight message is NOT lost); committed ones do not."""
+    broker = InMemoryBroker()
+    producer = _client(broker)
+    cfg = KafkaConfig(backend="memory", commit_after_process=True)
+    c1 = KafkaClient(cfg, broker=broker)
+    c1.setup_consumer([USER_MESSAGE_TOPIC])
+    producer.produce_message(USER_MESSAGE_TOPIC, "k", {"n": 1})
+    producer.produce_message(USER_MESSAGE_TOPIC, "k", {"n": 2})
+
+    m1 = c1.poll_message()
+    assert json.loads(m1.value().decode()) == {"n": 1}
+    # poll advanced the position, NOT the committed offset
+    m2 = c1.poll_message()
+    assert json.loads(m2.value().decode()) == {"n": 2}
+    # commit only the first message's offset (its handler completed)
+    c1.commit_offset(m1.topic(), m1.partition(), m1.offset() + 1)
+    c1.close()  # "crash" before n=2 commits
+
+    c2 = KafkaClient(cfg, broker=broker)
+    c2.setup_consumer([USER_MESSAGE_TOPIC])
+    redelivered = c2.poll_message()
+    assert redelivered is not None
+    assert json.loads(redelivered.value().decode()) == {"n": 2}
+    assert c2.poll_message() is None  # n=1 was committed; only n=2 replays
+
+
+def test_auto_commit_mode_never_redelivers():
+    """Default (commit_after_process off) keeps reference at-most-once
+    parity: poll commits, a rejoining consumer sees nothing twice."""
+    broker = InMemoryBroker()
+    producer = _client(broker)
+    c1 = _client(broker)
+    c1.setup_consumer([USER_MESSAGE_TOPIC])
+    producer.produce_message(USER_MESSAGE_TOPIC, "k", {"n": 1})
+    assert c1.poll_message() is not None
+    c1.close()
+    c2 = _client(broker)
+    c2.setup_consumer([USER_MESSAGE_TOPIC])
+    assert c2.poll_message() is None
+
+
+def test_message_timestamp_is_producer_stamped():
+    import time
+
+    broker = InMemoryBroker()
+    producer = _client(broker)
+    consumer = _client(broker)
+    consumer.setup_consumer([USER_MESSAGE_TOPIC])
+    before = time.time()
+    producer.produce_message(USER_MESSAGE_TOPIC, "k", {"n": 1})
+    msg = consumer.poll_message()
+    ts_type, ts_ms = msg.timestamp()
+    assert ts_type == 1  # TIMESTAMP_CREATE_TIME, as librdkafka reports
+    assert abs(ts_ms / 1000.0 - before) < 5.0
+
+
 def test_fault_injection_drop():
     broker = InMemoryBroker()
     broker.faults.drop_produce = lambda topic, value: value.get("drop", False)

@@ -1,0 +1,389 @@
+"""Overload-resilient serving plane (ISSUE 5; ROBUSTNESS.md).
+
+Pins the three-part resilience contract:
+
+- RECOMPUTE PREEMPTION: a preempted sequence keeps prompt + generated
+  tokens on its handle, replays through admission, and its greedy stream
+  is byte-identical to an unpreempted run — zero duplicate or dropped
+  tokens. Page pressure preempts the latest-deadline victim instead of
+  stalling the earliest-deadline candidate head-of-line.
+- ENGINE CIRCUIT BREAKER: ``breaker_threshold`` consecutive failed decode
+  rounds trip a rebuild of the engine's device state (weights retained);
+  in-flight streams survive byte-identically. A persistently wedged engine
+  gives up after ``breaker_max_rebuilds`` instead of rebuild-looping.
+- DEADLINE/SHED ADMISSION: past-deadline pending requests shed with a
+  structured retryable error and leak nothing; admission is
+  earliest-deadline-first with a starvation guard; ``max_queue_depth``
+  rejects new load with a retryable overload error.
+
+Plus the watchdog-timeout bugfix: a timed-out Kafka message releases its
+scheduler slot and KV pages BEFORE the timeout chunk is emitted.
+"""
+
+import asyncio
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from finchat_tpu.engine.engine import InferenceEngine
+from finchat_tpu.engine.sampler import SamplingParams
+from finchat_tpu.engine.scheduler import ContinuousBatchingScheduler, OverloadedError
+from finchat_tpu.models.llama import PRESETS, init_params
+from finchat_tpu.utils import faults
+from finchat_tpu.utils.config import EngineConfig
+from finchat_tpu.utils.metrics import METRICS
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faults.disarm_all()
+
+
+def _make_scheduler(**cfg_overrides):
+    """Tiny fp32 stack (fp32 pins greedy byte-identity across the
+    prefill-replay vs decode-step shapes — the same contract the mixed
+    step's identity tests use)."""
+    config = dataclasses.replace(PRESETS["tiny"], dtype=jnp.float32)
+    defaults = dict(
+        max_seqs=2, page_size=8, num_pages=64, max_seq_len=128,
+        prefill_chunk=16, session_cache=False,
+    )
+    defaults.update(cfg_overrides)
+    engine_cfg = EngineConfig(**defaults)
+    params = init_params(config, jax.random.key(0))
+    engine = InferenceEngine(config, params, engine_cfg)
+    return ContinuousBatchingScheduler(engine, eos_id=-1)
+
+
+async def _drain(handle):
+    tokens = []
+    while True:
+        event = await handle.events.get()
+        if event["type"] == "token":
+            tokens.append(event["token_id"])
+        elif event["type"] == "done":
+            return tokens, None
+        else:
+            return tokens, event
+
+
+def _greedy(max_new: int) -> SamplingParams:
+    return SamplingParams(temperature=0.0, max_new_tokens=max_new)
+
+
+# --- recompute preemption -------------------------------------------------
+
+def test_direct_preempt_replay_byte_identity():
+    """_preempt mid-decode, then replay: the stream completes with the
+    exact token sequence of an unpreempted greedy run."""
+    prompt = list(range(1, 20))
+
+    async def run(preempt_at: int | None):
+        scheduler = _make_scheduler()
+        await scheduler.start()
+        try:
+            handle = await scheduler.submit("s", prompt, _greedy(12))
+            task = asyncio.create_task(_drain(handle))
+            if preempt_at is not None:
+                while handle.generated < preempt_at:
+                    await asyncio.sleep(0.002)
+                scheduler._preempt(handle)
+            tokens, err = await task
+            scheduler.allocator.check_invariants()
+        finally:
+            await scheduler.stop()
+        return tokens, err, handle.preempted
+
+    clean, err, _ = asyncio.run(run(None))
+    assert err is None and len(clean) == 12
+    replayed, err, n_preempted = asyncio.run(run(4))
+    assert err is None
+    assert n_preempted == 1
+    assert replayed == clean, "preempt/replay duplicated or dropped tokens"
+
+
+def test_page_pressure_preempts_latest_deadline_victim():
+    """A page-starved earlier-deadline candidate preempts the deadline-less
+    hog instead of stalling head-of-line; both streams complete, and the
+    hog's replayed greedy stream is byte-identical to an uncontended run."""
+    hog_prompt = list(range(1, 24))  # + 24 new → 6 of the 7 usable pages
+    urgent_prompt = list(range(40, 56))  # + 8 new → 3 pages: must preempt
+
+    async def run(contended: bool):
+        scheduler = _make_scheduler(num_pages=8)
+        await scheduler.start()
+        try:
+            hog = await scheduler.submit("hog", hog_prompt, _greedy(24))
+            hog_task = asyncio.create_task(_drain(hog))
+            urgent_tokens = None
+            if contended:
+                while hog.generated < 3:
+                    await asyncio.sleep(0.002)
+                p0 = METRICS.get("finchat_preemptions_total")
+                urgent = await scheduler.submit(
+                    "urgent", urgent_prompt, _greedy(8),
+                    deadline=time.perf_counter() + 60.0,
+                )
+                urgent_tokens, uerr = await _drain(urgent)
+                assert uerr is None, uerr
+                assert METRICS.get("finchat_preemptions_total") > p0, (
+                    "page pressure never preempted"
+                )
+            hog_tokens, herr = await hog_task
+            assert herr is None, herr
+            scheduler.allocator.check_invariants()
+            assert scheduler.allocator.used_count == 0
+        finally:
+            await scheduler.stop()
+        return hog_tokens, urgent_tokens
+
+    clean_hog, _ = asyncio.run(run(False))
+    contended_hog, urgent_tokens = asyncio.run(run(True))
+    assert len(urgent_tokens) == 8
+    assert contended_hog == clean_hog, (
+        "preemption under page pressure changed the victim's greedy stream"
+    )
+
+
+# --- engine circuit breaker ----------------------------------------------
+
+def test_breaker_trips_rebuilds_and_streams_survive():
+    """breaker_threshold consecutive decode-round faults trip the breaker:
+    the engine device state is rebuilt, every in-flight greedy stream
+    completes byte-identical to a fault-free run, and the allocator is
+    clean afterwards."""
+    prompts = [list(range(1, 14)), list(range(20, 38))]
+
+    async def run(fault: bool):
+        scheduler = _make_scheduler()
+        rebuilt = []
+        scheduler.on_rebuild.append(lambda: rebuilt.append(True))
+        await scheduler.start()
+        try:
+            handles = [
+                await scheduler.submit(f"s{i}", p, _greedy(10))
+                for i, p in enumerate(prompts)
+            ]
+            tasks = [asyncio.create_task(_drain(h)) for h in handles]
+            if fault:
+                while any(h.generated < 2 for h in handles):
+                    await asyncio.sleep(0.002)
+                faults.arm(
+                    "scheduler.decode",
+                    faults.n_shot(scheduler.breaker_threshold,
+                                  RuntimeError("wedged dispatch")),
+                )
+            results = [await t for t in tasks]
+            assert all(err is None for _, err in results), results
+            scheduler.allocator.check_invariants()
+            assert scheduler.allocator.used_count == 0
+            assert len(scheduler.free_slots) == 2
+        finally:
+            await scheduler.stop()
+        return [tokens for tokens, _ in results], bool(rebuilt)
+
+    clean, rebuilt = asyncio.run(run(False))
+    assert not rebuilt
+    r0 = METRICS.get("finchat_engine_rebuilds_total")
+    survived, rebuilt = asyncio.run(run(True))
+    assert rebuilt, "on_rebuild callbacks never ran"
+    assert METRICS.get("finchat_engine_rebuilds_total") == r0 + 1
+    assert METRICS.get("finchat_breaker_state") == 0  # closed by the probe round
+    assert survived == clean, "streams did not survive the rebuild byte-identically"
+    # recovery latency was observed
+    assert METRICS.quantile("finchat_breaker_recovery_seconds", 0.5) > 0
+
+
+def test_breaker_gives_up_after_max_rebuilds_then_recovers():
+    """A PERSISTENT fault must not rebuild-loop forever: after
+    breaker_max_rebuilds consecutive trips the in-flight streams fail with
+    an error — and once the fault clears, the engine serves again."""
+
+    async def run():
+        scheduler = _make_scheduler(breaker_threshold=2, breaker_max_rebuilds=1)
+        await scheduler.start()
+        try:
+            def always_fail(**_ctx):
+                raise RuntimeError("dead device")
+
+            faults.arm("scheduler.decode", always_fail)
+            handle = await scheduler.submit("doomed", list(range(1, 14)), _greedy(8))
+            tokens, err = await asyncio.wait_for(_drain(handle), timeout=60)
+            assert err is not None and "dead device" in err["message"]
+            faults.disarm_all()
+            handle2 = await scheduler.submit("healthy", list(range(1, 14)), _greedy(8))
+            tokens2, err2 = await asyncio.wait_for(_drain(handle2), timeout=60)
+            assert err2 is None and len(tokens2) == 8
+            scheduler.allocator.check_invariants()
+        finally:
+            await scheduler.stop()
+
+    asyncio.run(run())
+
+
+# --- deadline shed / EDF admission / backpressure -------------------------
+
+def test_expired_deadline_sheds_with_structured_retryable_error():
+    """A pending request past its deadline is shed pre-admission with a
+    structured retryable error chunk — and frees nothing it never held."""
+
+    async def run():
+        scheduler = _make_scheduler()
+        await scheduler.start()
+        try:
+            s0 = METRICS.get("finchat_sheds_total")
+            handle = await scheduler.submit(
+                "late", list(range(1, 14)), _greedy(8),
+                deadline=time.perf_counter() - 1.0,
+            )
+            tokens, err = await asyncio.wait_for(_drain(handle), timeout=30)
+            assert tokens == []
+            assert err is not None
+            assert err["code"] == "deadline_exceeded"
+            assert err["retryable"] is True
+            assert METRICS.get("finchat_sheds_total") == s0 + 1
+            assert len(scheduler.free_slots) == 2
+            assert scheduler.allocator.used_count == 0
+        finally:
+            await scheduler.stop()
+
+    asyncio.run(run())
+
+
+def test_edf_ordering_and_starvation_guard():
+    """Admission order is earliest-deadline-first; an entry that has waited
+    past edf_starvation_seconds jumps ahead of deadline order."""
+
+    async def run():
+        scheduler = _make_scheduler(edf_starvation_seconds=5.0)
+        now = time.perf_counter()
+        a = await scheduler.submit("a", [1, 2, 3], _greedy(4))  # no deadline
+        b = await scheduler.submit("b", [1, 2, 3], _greedy(4), deadline=now + 50)
+        c = await scheduler.submit("c", [1, 2, 3], _greedy(4), deadline=now + 5)
+        scheduler._prepare_pending()
+        assert [h.seq_id for h in scheduler.pending] == ["c", "b", "a"]
+        # starve a: it jumps ahead of every deadline
+        a.submitted_at = now - 10.0
+        scheduler._prepare_pending()
+        assert [h.seq_id for h in scheduler.pending] == ["a", "c", "b"]
+
+    asyncio.run(run())
+
+
+def test_submit_backpressure_above_max_queue_depth():
+    async def run():
+        scheduler = _make_scheduler(max_queue_depth=1)
+        await scheduler.submit("q1", [1, 2, 3], _greedy(4))
+        with pytest.raises(OverloadedError) as ei:
+            await scheduler.submit("q2", [1, 2, 3], _greedy(4))
+        assert ei.value.retryable is True
+        assert ei.value.code == "overloaded"
+
+    asyncio.run(run())
+
+
+# --- watchdog timeout: no slot leak (serve/app.py bugfix) -----------------
+
+def _engine_app(scheduler, tokenizer, watchdog: float):
+    from finchat_tpu.engine.generator import EngineGenerator, StubGenerator
+    from finchat_tpu.io.kafka import InMemoryBroker, KafkaClient
+    from finchat_tpu.io.store import InMemoryStore
+    from finchat_tpu.serve.app import build_app
+    from finchat_tpu.utils.config import load_config
+
+    cfg = load_config(overrides={"model.preset": "stub"})
+    cfg.engine.watchdog_seconds = watchdog
+    cfg.engine.max_new_tokens = 96
+    cfg.engine.temperature = 0.0
+    broker = InMemoryBroker()
+    store = InMemoryStore()
+    store.upsert_context(
+        "c1", {"user_id": "u9", "name": "Alex", "income": 5000, "savings_goal": 800}
+    )
+    store.add_user_message("c1", "How am I doing?", "u9")
+
+    class NullRetriever:
+        async def __call__(self, args):
+            return []
+
+    app = build_app(
+        cfg, store=store, kafka=KafkaClient(cfg.kafka, broker=broker),
+        tool_generator=StubGenerator(default="No tool call"),
+        response_generator=EngineGenerator(scheduler, tokenizer),
+        retriever=NullRetriever(),
+    )
+    return app, broker
+
+
+async def test_watchdog_timeout_releases_slot_before_timeout_chunk():
+    """A timed-out Kafka message must cancel its in-flight generation and
+    release the scheduler slot + KV pages BEFORE the timeout chunk goes
+    out — the engine keeps full capacity after a watchdog fire."""
+    import json
+
+    from finchat_tpu.io.kafka import Message
+    from finchat_tpu.models.tokenizer import ByteTokenizer
+    from finchat_tpu.utils.config import AI_RESPONSE_TOPIC, USER_MESSAGE_TOPIC
+
+    tok = ByteTokenizer()
+    scheduler = _make_scheduler()
+    app, broker = _engine_app(scheduler, tok, watchdog=0.4)
+    await scheduler.start()
+    try:
+        # ~30 ms per decode dispatch: generation cannot finish 96 tokens
+        # inside the 0.4 s watchdog
+        faults.arm("scheduler.decode", lambda **_ctx: time.sleep(0.03))
+        payload = {"message": "tell me everything", "conversation_id": "c1",
+                   "user_id": "u9"}
+        msg = Message(USER_MESSAGE_TOPIC, "c1", json.dumps(payload).encode())
+        await app._process_with_watchdog(msg, payload, None)
+        # the fix's ordering guarantee: by the time the timeout chunk is
+        # emitted (i.e. _process_with_watchdog returned), the slot and
+        # every KV page are already back — no drain/grace loop here
+        assert scheduler.allocator.used_count == 0, "timed-out message leaked KV pages"
+        assert not scheduler.decoding and not scheduler.prefilling
+        assert len(scheduler.free_slots) == 2, "timed-out message leaked its slot"
+        out = [json.loads(m.value().decode()) for m in broker.drain(AI_RESPONSE_TOPIC)]
+        assert out and out[-1]["message"] == "Request timed out. Please try again."
+        assert out[-1]["error"] is True
+    finally:
+        faults.disarm_all()
+        await scheduler.stop()
+
+
+async def test_expired_kafka_message_sheds_with_structured_error_chunk():
+    """End-to-end deadline plane: a Kafka message whose producer timestamp
+    is far in the past (deadline = timestamp + allowance) is shed by the
+    scheduler and the outbound error chunk carries the structured
+    code/retryable fields."""
+    import json
+
+    from finchat_tpu.io.kafka import Message
+    from finchat_tpu.models.tokenizer import ByteTokenizer
+    from finchat_tpu.utils.config import AI_RESPONSE_TOPIC, USER_MESSAGE_TOPIC
+
+    tok = ByteTokenizer()
+    scheduler = _make_scheduler()
+    app, broker = _engine_app(scheduler, tok, watchdog=30.0)
+    app.cfg.engine.request_deadline_seconds = 5.0
+    await scheduler.start()
+    try:
+        payload = {"message": "too late", "conversation_id": "c1", "user_id": "u9"}
+        msg = Message(
+            USER_MESSAGE_TOPIC, "c1", json.dumps(payload).encode(),
+            timestamp_ms=int((time.time() - 120.0) * 1000),
+        )
+        await app._process_with_watchdog(msg, payload, None)
+        out = [json.loads(m.value().decode()) for m in broker.drain(AI_RESPONSE_TOPIC)]
+        assert out, "expected a shed error chunk"
+        err = out[-1]
+        assert err["error"] is True and err["last_message"] is True
+        assert err["code"] == "deadline_exceeded"
+        assert err["retryable"] is True
+        assert scheduler.allocator.used_count == 0
+    finally:
+        await scheduler.stop()
