@@ -135,6 +135,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--chaos-rates", default="0.05,0.2",
                    help="comma-separated decode-fault probabilities for "
                         "the --chaos-sweep rate section")
+    p.add_argument("--fleet-sweep", action="store_true",
+                   help="CPU-runnable fleet chaos drill (ISSUE 6): N "
+                        "engine replicas under the conversation-affinity "
+                        "router, one killed mid-stream — in-flight streams "
+                        "must drain to siblings and complete byte-"
+                        "identical, the victim goes OUT and is respawned, "
+                        "goodput ≥ (N-1)/N during the outage and 1.0 "
+                        "after, and a migrated conversation resumes from "
+                        "its handed-off session-cache bytes")
+    p.add_argument("--fleet-smoke", action="store_true",
+                   help="tiny --fleet-sweep variant for CI: same gates, "
+                        "same drill (the drill IS the smoke — it is "
+                        "CPU-sized already)")
+    p.add_argument("--fleet-replicas", type=int, default=4,
+                   help="replica count for --fleet-sweep")
     p.add_argument("--tpu-timeout", type=float, default=180.0,
                    help="seconds allowed for TPU backend INIT before the "
                         "child is declared hung (measurement gets "
@@ -186,7 +201,11 @@ def run_worker(args: argparse.Namespace) -> int:
     faulthandler.dump_traceback_later(max(60.0, args.measure_budget - 10.0), exit=True)
 
     work = resolve_workload(args, "tpu" if platform == "tpu" else "cpu")
-    if args.chaos_sweep or args.chaos_smoke:
+    if args.fleet_sweep or args.fleet_smoke:
+        result = measure_fleet_sweep(
+            smoke=args.fleet_smoke, replicas=args.fleet_replicas
+        )
+    elif args.chaos_sweep or args.chaos_smoke:
         result = measure_chaos_sweep(
             smoke=args.chaos_smoke,
             rates=tuple(float(r) for r in args.chaos_rates.split(",")),
@@ -1377,6 +1396,254 @@ def measure_chaos_sweep(smoke: bool = False, rates: tuple = (0.05, 0.2)) -> dict
     }
 
 
+def measure_fleet_sweep(smoke: bool = False, replicas: int = 4) -> dict:
+    """Fleet chaos drill (ISSUE 6), CPU-runnable through REAL schedulers on
+    the tiny fp32 config (fp32 pins greedy byte-identity across replicas —
+    they share one params tree, so routing cannot change a greedy stream).
+
+    With ``replicas`` engine replicas under one router, kill one mid-stream
+    (wedge its decode dispatches until the breaker gives up):
+
+    - every in-flight stream must COMPLETE BYTE-IDENTICAL on a sibling
+      (breaker drain → adopt → recompute replay), zero user-visible errors;
+    - the killed replica goes OUT (its partitions reassign) and the
+      supervisor respawns it once the fault clears — replicas_live returns
+      to N;
+    - goodput for a request wave DURING the outage ≥ 3/4 (the router
+      excludes the dead replica; survivors absorb), and 1.0 after respawn;
+    - a conversation whose session-cache bytes lived on the killed replica
+      gets them MIGRATED to the sibling its next turn routes to, and that
+      turn admission-resumes from them (resumed, not cold, prefill
+      profile: fewer prefill chunks than a cold start).
+    """
+    import asyncio
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from finchat_tpu.engine.engine import InferenceEngine
+    from finchat_tpu.engine.sampler import SamplingParams
+    from finchat_tpu.engine.scheduler import ContinuousBatchingScheduler
+    from finchat_tpu.models.llama import PRESETS, init_params
+    from finchat_tpu.serve.fleet import LIVE, EngineFleet, EngineReplica
+    from finchat_tpu.utils import faults
+    from finchat_tpu.utils.config import EngineConfig, FleetConfig
+    from finchat_tpu.utils.metrics import METRICS
+
+    config = dataclasses.replace(PRESETS["tiny"], dtype=jnp.float32)
+    params = init_params(config, jax.random.key(0))
+    PAGE, CHUNK = 8, 16
+
+    def make_fleet() -> EngineFleet:
+        reps = []
+        for i in range(replicas):
+            cfg = EngineConfig(
+                max_seqs=3, page_size=PAGE, num_pages=96, max_seq_len=256,
+                prefill_chunk=CHUNK, session_cache=True,
+                session_cache_bytes=32 << 20, breaker_max_rebuilds=1,
+            )
+            engine = InferenceEngine(config, params, cfg)
+            rid = str(i)
+            reps.append(EngineReplica(
+                replica_id=rid,
+                scheduler=ContinuousBatchingScheduler(
+                    engine, eos_id=-1,
+                    metrics=METRICS.labeled(replica=rid), replica_id=rid,
+                ),
+            ))
+        return EngineFleet(
+            reps,
+            FleetConfig(replicas=replicas, respawn_backoff_seconds=0.05,
+                        supervisor_interval_seconds=0.05),
+            num_partitions=32,
+        )
+
+    async def drain(handle):
+        tokens = []
+        while True:
+            ev = await handle.events.get()
+            if ev["type"] == "token":
+                tokens.append(ev["token_id"])
+            elif ev["type"] == "done":
+                return tokens, None
+            else:
+                return tokens, ev
+
+    greedy = lambda n: SamplingParams(temperature=0.0, max_new_tokens=n)  # noqa: E731
+    t1_prompt = list(range(1, 14))
+    stream_prompts = {f"fc{i}": list(range(10 * i + 1, 10 * i + 14))
+                      for i in range(1, 5)}
+    wave_n = 4 if smoke else 12
+
+    async def turn(fleet, conv, prompt, n_new=10):
+        rep = fleet.replica_for(conv)
+        h = await rep.scheduler.submit(f"{conv}-t", prompt, greedy(n_new),
+                                       conversation_id=conv)
+        toks, err = await asyncio.wait_for(
+            asyncio.ensure_future(drain(h)), timeout=300)
+        return toks, err, h
+
+    async def scenario(fault: bool) -> dict:
+        fleet = make_fleet()
+        await fleet.start()
+        out: dict = {"errors": 0}
+        try:
+            # conversation "fmig": turn 1 retires a session entry on its
+            # home replica — the one we will kill
+            t1_tokens, err, _ = await turn(fleet, "fmig", t1_prompt)
+            assert err is None, err
+            out["t1_tokens"] = t1_tokens
+            victim = fleet.replica_for("fmig")
+            # in-flight streams spread over the fleet, plus one GUARANTEED
+            # on the victim (the kill must be mid-stream there): scan conv
+            # names until one routes to fmig's home replica
+            prompts = dict(stream_prompts)
+            conv_v = next(f"fv-{i}" for i in range(200)
+                          if fleet.replica_for(f"fv-{i}") is victim)
+            prompts[conv_v] = list(range(90, 104))
+            handles = {}
+            for conv, prompt in prompts.items():
+                rep = fleet.replica_for(conv)
+                handles[conv] = await rep.scheduler.submit(
+                    conv + "-s", prompt, greedy(10), conversation_id=conv)
+            tasks = {c: asyncio.create_task(drain(h)) for c, h in handles.items()}
+            if fault:
+                while any(h.generated < 2 for h in handles.values()):
+                    await asyncio.sleep(0.002)
+                dead = [True]
+
+                def wedge(**ctx):
+                    if dead[0] and ctx.get("replica") == victim.replica_id:
+                        raise RuntimeError("fleet drill: dead replica")
+
+                faults.arm("scheduler.decode", wedge)
+                # a dead device fails its revive rebuild too: the victim
+                # stays OUT (supervisor backing off) until the heal, so
+                # the outage wave and the migration turn below really run
+                # against the survivor set
+                faults.arm("engine.rebuild", wedge)
+            results = {c: await asyncio.wait_for(t, timeout=300)
+                       for c, t in tasks.items()}
+            out["stream_tokens"] = {c: toks for c, (toks, _e) in results.items()}
+            out["errors"] += sum(1 for _toks, e in results.values() if e is not None)
+            if fault:
+                # keep poking the wedged replica until the breaker gives up
+                # (probe streams drain to siblings and complete)
+                for i in range(8):
+                    if victim.scheduler.gave_up or victim.state != LIVE:
+                        break
+                    h = await victim.scheduler.submit(
+                        f"probe{i}", list(range(200 + i, 212 + i)), greedy(4))
+                    _toks, e = await asyncio.wait_for(
+                        asyncio.ensure_future(drain(h)), timeout=300)
+                    out["errors"] += 1 if e is not None else 0
+                for _ in range(3000):
+                    if victim.state != LIVE:
+                        break
+                    await asyncio.sleep(0.01)
+                out["victim_out"] = victim.state != LIVE
+                out["live_during"] = int(METRICS.get("finchat_fleet_replicas_live"))
+                # outage wave: the router spreads over the survivors
+                wave = []
+                for i in range(wave_n):
+                    conv = f"wave-{i}"
+                    rep = fleet.replica_for(conv)
+                    wave.append(await rep.scheduler.submit(
+                        conv, list(range(60 + i, 74 + i)), greedy(6),
+                        conversation_id=conv))
+                wave_res = [await asyncio.wait_for(
+                    asyncio.ensure_future(drain(h)), timeout=300) for h in wave]
+                out["goodput_during"] = (
+                    sum(1 for _t, e in wave_res if e is None) / wave_n)
+            # turn 2 of fmig: during the outage it routes to a sibling,
+            # which must MIGRATE the session bytes and resume from them
+            t2_prompt = t1_prompt + t1_tokens + [7, 8, 9]
+            t2_tokens, err, t2_handle = await turn(fleet, "fmig", t2_prompt)
+            out["errors"] += 1 if err is not None else 0
+            out["t2_tokens"] = t2_tokens
+            out["t2_resumed_len"] = t2_handle.resumed_len
+            if fault:
+                # heal the device; the supervisor respawns the replica
+                dead[0] = False
+                for _ in range(3000):
+                    if victim.state == LIVE:
+                        break
+                    await asyncio.sleep(0.01)
+                out["victim_respawned"] = victim.state == LIVE
+                out["live_after"] = int(METRICS.get("finchat_fleet_replicas_live"))
+                wave = []
+                for i in range(wave_n):
+                    conv = f"after-{i}"
+                    rep = fleet.replica_for(conv)
+                    wave.append(await rep.scheduler.submit(
+                        conv, list(range(120 + i, 134 + i)), greedy(6),
+                        conversation_id=conv))
+                wave_res = [await asyncio.wait_for(
+                    asyncio.ensure_future(drain(h)), timeout=300) for h in wave]
+                out["goodput_after"] = (
+                    sum(1 for _t, e in wave_res if e is None) / wave_n)
+            for rep in fleet.replicas:
+                rep.scheduler.allocator.check_invariants()
+        finally:
+            await fleet.stop()
+            faults.disarm_all()
+        return out
+
+    d0 = METRICS.get("finchat_fleet_drained_streams_total")
+    m0 = METRICS.get("finchat_fleet_session_migrations_total")
+    clean = asyncio.run(scenario(False))
+    t0 = time.perf_counter()
+    chaos = asyncio.run(scenario(True))
+    wall = time.perf_counter() - t0
+    drained = int(METRICS.get("finchat_fleet_drained_streams_total") - d0)
+    migrations = int(METRICS.get("finchat_fleet_session_migrations_total") - m0)
+
+    kill_identical = (
+        chaos["stream_tokens"] == clean["stream_tokens"]
+        and chaos["t2_tokens"] == clean["t2_tokens"]
+    )
+    resumed = int(chaos["t2_resumed_len"])
+    t2_len = len(t1_prompt) + len(clean["t1_tokens"]) + 3
+    chunks_cold = -(-t2_len // CHUNK)
+    chunks_resumed = -(-(t2_len - resumed) // CHUNK)
+    migrated_resume_ok = migrations >= 1 and resumed > 0 and chunks_resumed < chunks_cold
+    print(f"[bench] fleet kill-one: drained={drained} errors={chaos['errors']} "
+          f"identical={kill_identical} victim_out={chaos.get('victim_out')} "
+          f"respawned={chaos.get('victim_respawned')}", file=sys.stderr, flush=True)
+    print(f"[bench] fleet goodput: during={chaos.get('goodput_during')} "
+          f"after={chaos.get('goodput_after')} live {chaos.get('live_during')}"
+          f"→{chaos.get('live_after')}", file=sys.stderr, flush=True)
+    print(f"[bench] fleet migration: migrations={migrations} resumed_len={resumed} "
+          f"prefill_chunks {chunks_cold}→{chunks_resumed}", file=sys.stderr, flush=True)
+
+    return {
+        "metric": "fleet_sweep",
+        "unit": "goodput, drained streams, migrations",
+        "smoke": smoke,
+        "replicas": replicas,
+        "model": "tiny (fp32 — identity contract, see measure_fleet_sweep)",
+        # acceptance gates (tier1.yml --fleet-smoke; ISSUE 6)
+        "streams_survive_kill": chaos["errors"] == 0,
+        "kill_outputs_identical": kill_identical,
+        "drained_streams": drained,
+        "victim_out": bool(chaos.get("victim_out")),
+        "victim_respawned": bool(chaos.get("victim_respawned")),
+        "replicas_live_during": chaos.get("live_during"),
+        "replicas_live_after": chaos.get("live_after"),
+        "goodput_during": chaos.get("goodput_during"),
+        "goodput_after": chaos.get("goodput_after"),
+        "session_migrations": migrations,
+        "t2_resumed_len": resumed,
+        "prefill_chunks_cold": chunks_cold,
+        "prefill_chunks_resumed": chunks_resumed,
+        "migrated_resume_ok": migrated_resume_ok,
+        "wall_s": round(wall, 2),
+        "platform": jax.devices()[0].platform,
+        "device": str(jax.devices()[0]),
+    }
+
+
 # --------------------------------------------------------------------------
 # Orchestrator: jax-free; spawns workers, never hangs, always prints JSON.
 # --------------------------------------------------------------------------
@@ -1409,6 +1676,9 @@ def spawn_worker(args: argparse.Namespace, platform: str, timeout: float) -> dic
     if args.chaos_sweep or args.chaos_smoke:
         cmd += ["--chaos-rates", args.chaos_rates]
         cmd += ["--chaos-smoke"] if args.chaos_smoke else ["--chaos-sweep"]
+    if args.fleet_sweep or args.fleet_smoke:
+        cmd += ["--fleet-replicas", str(args.fleet_replicas)]
+        cmd += ["--fleet-smoke"] if args.fleet_smoke else ["--fleet-sweep"]
     print(f"[bench] spawning {platform} worker (timeout {timeout:.0f}s)",
           file=sys.stderr, flush=True)
     try:

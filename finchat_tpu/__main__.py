@@ -5,9 +5,13 @@ The reference's process layer is gunicorn spawning N uvicorn workers
 way — the chip is a singleton per process — so the equivalent here is one
 process owning the engine, with concurrency supplied by the continuous-
 batching scheduler instead of worker replication (SURVEY §2.3 DP note).
-Multi-replica serving = one process per chip/slice, each its own Kafka
-consumer-group member (the same partition-spreading the reference relies
-on, kafka_client.py:17).
+Scale-out has two layers: ``--fleet-replicas N`` stands up N engine
+replicas INSIDE this process under one conversation-affinity router with
+breaker drain-to-sibling and supervised respawn (serve/fleet.py —
+ROBUSTNESS.md), and multi-host serving runs one such process per
+chip/slice, each its own Kafka consumer-group member (the same
+partition-spreading the reference relies on, kafka_client.py:17; the
+router hashes the SAME partition ids, so affinity survives both layers).
 
 Env compatibility: every reference env var keeps working (utils/config.py);
 ``FINCHAT_*`` adds the new surface. ``--watchdog`` mirrors the reference's
@@ -46,6 +50,11 @@ def main() -> None:
                         "retryable error and admission goes earliest-"
                         "deadline-first (ROBUSTNESS.md); 0 = off — also "
                         "FINCHAT_REQUEST_DEADLINE_SECONDS")
+    p.add_argument("--fleet-replicas", type=int, default=None,
+                   help="engine replicas under this worker's serving plane "
+                        "(serve/fleet.py): conversation-affinity routing, "
+                        "breaker drains to siblings, supervised respawn; "
+                        "1 = single engine — also FINCHAT_FLEET_REPLICAS")
     args = p.parse_args()
 
     overrides: dict = {}
@@ -59,6 +68,8 @@ def main() -> None:
         overrides["engine.session_cache_bytes"] = args.session_cache_bytes
     if args.request_deadline_seconds is not None:
         overrides["engine.request_deadline_seconds"] = args.request_deadline_seconds
+    if args.fleet_replicas is not None:
+        overrides["fleet.replicas"] = args.fleet_replicas
     cfg = load_config(args.config, overrides)
 
     from finchat_tpu.serve.app import build_app

@@ -27,6 +27,7 @@ from finchat_tpu.agent.state import AgentState, ToolCall
 from finchat_tpu.agent.toolcall import parse_tool_decision
 from finchat_tpu.engine.generator import TextGenerator
 from finchat_tpu.engine.sampler import SamplingParams
+from finchat_tpu.engine.session_cache import session_key
 from finchat_tpu.io.schemas import ChatMessage
 from finchat_tpu.models.tokenizer import render_chat
 from finchat_tpu.utils.logging import get_logger
@@ -257,7 +258,7 @@ class LLMAgent:
         matcher sees the other role's prompt as a divergent history)."""
         if not state.conversation_id:
             return None
-        return f"{state.conversation_id}#{role}"
+        return session_key(state.conversation_id, role)
 
     # --- nodes -----------------------------------------------------------
     async def _decide_retrieval_node(self, state: AgentState) -> AgentState:

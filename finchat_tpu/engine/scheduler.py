@@ -135,6 +135,12 @@ class SequenceHandle:
     # the previous entry's host bytes for them instead of a fresh D2H copy
     resumed_len: int = 0
     generated: int = 0
+    # the scheduler currently driving this handle: set at submit and
+    # REBOUND by a fleet drain adoption (serve/fleet.py) — cleanup paths
+    # (generator cancel on disconnect/watchdog) hold a reference to the
+    # ORIGINAL scheduler, and evicting there with the adopter's slot index
+    # would corrupt the source's slot state; cancel() delegates to owner
+    owner: object | None = None
     # prompt + delivered tokens — the prompt-lookup draft source when
     # speculative decoding is on (engine/spec.py); maintained by _deliver
     history: list[int] = field(default_factory=list)
@@ -262,9 +268,17 @@ class ContinuousBatchingScheduler:
     # ...and re-probe after this many pipelined steps
     SPEC_RETRY_EVERY = 16
 
-    def __init__(self, engine: InferenceEngine, eos_id: int):
+    def __init__(self, engine: InferenceEngine, eos_id: int,
+                 metrics=None, replica_id: str | None = None):
         self.engine = engine
         self.eos_id = eos_id
+        # fleet identity (serve/fleet.py): ``replica_id`` tags this
+        # scheduler's fault-injection sites (so a chaos test can wedge ONE
+        # replica) and ``metrics`` is a METRICS.labeled(replica=...) view
+        # so every existing metric family comes out per-replica. Both
+        # default to the single-engine behavior unchanged.
+        self.replica_id = replica_id
+        self.metrics = metrics if metrics is not None else METRICS
         cfg = engine.engine_cfg
         self.allocator = PageAllocator(cfg.num_pages)
         self.free_slots: list[int] = list(range(cfg.max_seqs))
@@ -299,7 +313,7 @@ class ContinuousBatchingScheduler:
         # are demoted to a single decode_step riding the same iteration,
         # and spec-decode iterations keep their own depth-1 verify cadence
         self.loop_depth = engine.decode_loop_depth
-        METRICS.set_gauge("finchat_decode_loop_depth", self.loop_depth)
+        self.metrics.set_gauge("finchat_decode_loop_depth", self.loop_depth)
         # unified mixed prefill+decode step (engine.mixed_step config): one
         # ragged dispatch advances every prefilling row a chunk AND every
         # decoding row a token whenever both populations exist and nothing
@@ -343,9 +357,23 @@ class ContinuousBatchingScheduler:
         # callbacks run after an engine rebuild (the serving layer uses one
         # to re-register its shared prompt heads — the rebuild dropped them)
         self.on_rebuild: list = []
+        # --- fleet hooks (serve/fleet.py; ISSUE 6) ----------------------
+        # drain sink: when set, a breaker trip offers every live/pending
+        # handle (preempted to host first — prompt+generated tokens on the
+        # handle, device-free) plus its conversation's exported
+        # session-cache bytes to the sink instead of riding out the
+        # rebuild here; the sink returns True when a sibling replica
+        # adopted the stream. Signature: (handle, session_payload) -> bool.
+        self.drain_sink = None
+        # callbacks fired when the breaker gives up (the supervisor marks
+        # this replica OUT and schedules a respawn)
+        self.on_give_up: list = []
+        # breaker give-up state: True from give-up until revive() —
+        # the fleet router stops routing here while set
+        self.gave_up = False
         # breaker state gauge: 0 closed, 1 open (rebuilding), 2 half-open
         # (rebuilt, awaiting the first successful probe round)
-        METRICS.set_gauge("finchat_breaker_state", 0)
+        self.metrics.set_gauge("finchat_breaker_state", 0)
         # session KV cache (engine/session_cache.py): host-RAM tier keyed by
         # conversation_id; None = disabled. The on_drop hook is where entry
         # references on shared-prefix pages are released.
@@ -355,7 +383,7 @@ class ContinuousBatchingScheduler:
 
             self.session_cache = SessionKVCache(
                 cfg.session_cache_bytes, page_size=cfg.page_size,
-                on_drop=self._session_drop,
+                on_drop=self._session_drop, metrics=self.metrics,
             )
 
     # --- public API -----------------------------------------------------
@@ -391,7 +419,7 @@ class ContinuousBatchingScheduler:
             # backpressure: reject NEW load above the bound with a
             # retryable error instead of queueing unboundedly (preempted
             # sequences bypass submit — they are live streams, not load)
-            METRICS.inc("finchat_overload_rejections_total")
+            self.metrics.inc("finchat_overload_rejections_total")
             raise OverloadedError(
                 f"admission queue full ({len(self.pending)} >= "
                 f"{self.max_queue_depth}); retry with backoff"
@@ -415,17 +443,17 @@ class ContinuousBatchingScheduler:
                     "contract)",
                     seq_id, sampling.top_k, CANDIDATES,
                 )
-            METRICS.inc("finchat_top_k_clamped_total")
+            self.metrics.inc("finchat_top_k_clamped_total")
             import dataclasses as _dc
 
             sampling = _dc.replace(sampling, top_k=CANDIDATES)
         handle = SequenceHandle(
             seq_id=seq_id, prompt_ids=list(prompt_ids), sampling=sampling,
             constraint=constraint, conversation_id=conversation_id,
-            deadline=deadline,
+            deadline=deadline, owner=self,
         )
         self.pending.append(handle)
-        METRICS.set_gauge("finchat_queue_depth", len(self.pending))
+        self.metrics.set_gauge("finchat_queue_depth", len(self.pending))
         self._wakeup.set()
         return handle
 
@@ -470,7 +498,7 @@ class ContinuousBatchingScheduler:
         # before admission can see the handle
         handle.held = True
         handle.held_deadline = time.perf_counter() + self.HOLD_TTL_S
-        METRICS.inc("finchat_partial_holds_total")
+        self.metrics.inc("finchat_partial_holds_total")
         return handle
 
     def extend_prompt(self, handle: SequenceHandle, full_ids: list[int]) -> bool:
@@ -484,11 +512,11 @@ class ContinuousBatchingScheduler:
             return False
         prefix = handle.prompt_ids
         if len(full_ids) <= len(prefix) or full_ids[: len(prefix)] != prefix:
-            METRICS.inc("finchat_partial_fallbacks_total")
+            self.metrics.inc("finchat_partial_fallbacks_total")
             return False
         max_len = self.engine.max_pages_per_seq * self.engine.page_size
         if len(full_ids) + handle.sampling.max_new_tokens > max_len:
-            METRICS.inc("finchat_partial_fallbacks_total")
+            self.metrics.inc("finchat_partial_fallbacks_total")
             return False
         if handle.slot >= 0:
             total = pages_needed(
@@ -498,7 +526,7 @@ class ContinuousBatchingScheduler:
             extra = total - len(handle.page_list)
             if extra > 0:
                 if total > self.engine.max_pages_per_seq or not self.allocator.can_allocate(extra):
-                    METRICS.inc("finchat_partial_fallbacks_total")
+                    self.metrics.inc("finchat_partial_fallbacks_total")
                     return False
                 new_pages = self.allocator.allocate(handle.seq_id, extra)
                 handle.page_list = handle.page_list + new_pages
@@ -507,7 +535,7 @@ class ContinuousBatchingScheduler:
         handle.history = list(full_ids)
         handle.held = False
         handle.grafted = True
-        METRICS.inc("finchat_partial_grafts_total")
+        self.metrics.inc("finchat_partial_grafts_total")
         self._wakeup.set()
         return True
 
@@ -547,11 +575,11 @@ class ContinuousBatchingScheduler:
                     "partial hold %s expired after %.0fs without extend_prompt; "
                     "reclaiming its slot and pages", handle.seq_id, self.HOLD_TTL_S,
                 )
-                METRICS.inc("finchat_partial_stale_reaps_total")
+                self.metrics.inc("finchat_partial_stale_reaps_total")
                 self._evict(handle, "error", error="partial hold expired")
         for handle in list(self.pending):
             if handle.held and now > handle.held_deadline:
-                METRICS.inc("finchat_partial_stale_reaps_total")
+                self.metrics.inc("finchat_partial_stale_reaps_total")
                 self.pending.remove(handle)
                 handle.finished = True
                 handle.span.finish()
@@ -650,7 +678,19 @@ class ContinuousBatchingScheduler:
     def _fail_prefix_job(self, job: _PrefixJob) -> None:
         self._prefix_jobs.remove(job)
         self.allocator.free(job.owner, job.pages)
-        self.engine.reset_slot(job.slot)
+        try:
+            self.engine.reset_slot(job.slot)
+        except Exception as e:
+            # reset_slot is a device op and the device may be the very
+            # reason this job is failing: log, don't propagate — the job
+            # is already off _prefix_jobs, so an escaping exception would
+            # skip the remaining jobs in unguarded callers
+            # (_fail_prefill_round, stop) and kill the scheduler loop,
+            # stranding their awaiters forever
+            logger.error("reset_slot during prefix-job failure: %s", e)
+        # the slot must come back and the future must resolve regardless,
+        # or register_prefix_async's awaiter hangs (no later pass can
+        # resolve a job that is no longer listed)
         self.free_slots.append(job.slot)
         if not job.future.done():
             job.future.set_result(0)
@@ -697,6 +737,12 @@ class ContinuousBatchingScheduler:
         """Client went away (e.g. watchdog timeout): evict and free."""
         if handle.finished:
             return
+        if handle.owner is not None and handle.owner is not self:
+            # a fleet drain adopted this handle elsewhere: its slot/pages
+            # live on the adopter now — evicting HERE with the adopter's
+            # slot index would free an unrelated stream's slot
+            handle.owner.cancel(handle)
+            return
         if handle in self.pending:
             self.pending.remove(handle)
             self._finish(handle, "cancelled")
@@ -727,7 +773,7 @@ class ContinuousBatchingScheduler:
             if (handle.deadline is not None and now > handle.deadline
                     and handle.generated == 0 and not handle.preempted):
                 self.pending.remove(handle)
-                METRICS.inc("finchat_sheds_total")
+                self.metrics.inc("finchat_sheds_total")
                 handle.finished = True
                 handle.span.finish()
                 handle.events.put_nowait({
@@ -736,7 +782,7 @@ class ContinuousBatchingScheduler:
                     "code": "deadline_exceeded",
                     "retryable": True,
                 })
-        METRICS.set_gauge("finchat_queue_depth", len(self.pending))
+        self.metrics.set_gauge("finchat_queue_depth", len(self.pending))
 
     def _prepare_pending(self) -> None:
         """Shed expired entries, then order the queue for admission:
@@ -823,9 +869,9 @@ class ContinuousBatchingScheduler:
             if n_restore:
                 try:
                     inject("session.restore", seq_id=handle.seq_id)
-                    with Timer(METRICS, "finchat_session_restore_seconds"):
+                    with Timer(self.metrics, "finchat_session_restore_seconds"):
                         self.engine.restore_pages(pages[:n_restore], s_entry.snap)
-                    METRICS.inc("finchat_session_cache_restored_tokens_total",
+                    self.metrics.inc("finchat_session_cache_restored_tokens_total",
                                 resume_pos)
                 except Exception as e:
                     # a failed restore must not kill the stream OR leak the
@@ -852,7 +898,7 @@ class ContinuousBatchingScheduler:
                 # its plan — a page-starved head-of-line retry or a failed
                 # restore (demoted to a cold start above) must not inflate
                 # the hit rate
-                METRICS.inc("finchat_session_cache_hits_total" if s_entry is not None
+                self.metrics.inc("finchat_session_cache_hits_total" if s_entry is not None
                             else "finchat_session_cache_misses_total")
             # shared/restored head pages lead (logical pages 0..): the slot
             # reads them read-only — its own writes all land at positions >=
@@ -868,8 +914,8 @@ class ContinuousBatchingScheduler:
                 ctx_rows[slot] = resume_pos
                 handle.prefill_pos = resume_pos
                 if s_entry is None:
-                    METRICS.inc("finchat_prefix_hits_total")
-                    METRICS.inc("finchat_prefix_tokens_saved_total", shared_len)
+                    self.metrics.inc("finchat_prefix_hits_total")
+                    self.metrics.inc("finchat_prefix_tokens_saved_total", shared_len)
             handle.slot = slot
             handle.span.mark("admitted")
             if handle.constraint is None:
@@ -889,7 +935,7 @@ class ContinuousBatchingScheduler:
             self.engine.set_page_table_rows(admitted)
             if ctx_rows:
                 self.engine.set_context_lens_rows(ctx_rows)
-            METRICS.set_gauge("finchat_queue_depth", len(self.pending))
+            self.metrics.set_gauge("finchat_queue_depth", len(self.pending))
 
     def _finish(self, handle: SequenceHandle, reason: str) -> None:
         handle.finished = True
@@ -969,7 +1015,7 @@ class ContinuousBatchingScheduler:
         own_ids = handle.page_list[shared // page + reuse_pages : n_tok // page]
         try:
             inject("session.offload", seq_id=handle.seq_id)
-            with Timer(METRICS, "finchat_session_offload_seconds"):
+            with Timer(self.metrics, "finchat_session_offload_seconds"):
                 snap_new = self.engine.offload_pages(own_ids) if own_ids else None
         except Exception as e:  # cache is an optimization; never fail eviction
             logger.error("session cache offload failed for %s: %s", handle.seq_id, e)
@@ -990,7 +1036,7 @@ class ContinuousBatchingScheduler:
         if entry.prefix_entry is not None:
             entry.prefix_entry.refs += 1
         if cache.put(entry):
-            METRICS.inc("finchat_session_cache_offloaded_pages_total", len(own_ids))
+            self.metrics.inc("finchat_session_cache_offloaded_pages_total", len(own_ids))
         elif entry.prefix_entry is not None:
             entry.prefix_entry.refs -= 1
             self._reap_prefixes()
@@ -1070,8 +1116,8 @@ class ContinuousBatchingScheduler:
         # streams mid-answer, and _prepare_pending's EDF ordering applies
         # on top when deadlines are in play
         self.pending.appendleft(handle)
-        METRICS.inc("finchat_preemptions_total")
-        METRICS.set_gauge("finchat_queue_depth", len(self.pending))
+        self.metrics.inc("finchat_preemptions_total")
+        self.metrics.set_gauge("finchat_queue_depth", len(self.pending))
         self._wakeup.set()
 
     def _preemption_plan(self) -> list[SequenceHandle]:
@@ -1127,6 +1173,213 @@ class ContinuousBatchingScheduler:
                 return victims
         return []
 
+    # --- fleet surface (serve/fleet.py; ISSUE 6) ------------------------
+    def adopt(self, handle: SequenceHandle) -> bool:
+        """Admit a handle drained from a sibling replica. The handle
+        arrives device-free — ``_preempt`` normalized it (prompt_ids =
+        full history, slot -1, no pages, epoch bumped past every stale
+        membership snapshot) — and its ``events`` queue travels WITH it,
+        so the original consumer keeps streaming with no seam: the next
+        token it sees is exactly the next token of the stream. Live
+        streams (already-delivered tokens) jump the queue the same way
+        local preemption replays do — they are always adopted, exactly as
+        a local preempt-replay never counts against the bound. A
+        NEVER-admitted handle is plain queued load wearing a drain coat:
+        it honors ``max_queue_depth`` like any fresh submit (refused →
+        False), or a victim's give-up would transplant its whole backlog
+        past the sibling's backpressure bound and lock out new clients
+        with OverloadedError until it drains. Returns whether the handle
+        was taken."""
+        if handle.finished:
+            return True
+        live = bool(handle.preempted or handle.generated)
+        if (not live and self.max_queue_depth > 0
+                and len(self.pending) >= self.max_queue_depth):
+            return False
+        handle.owner = self  # cleanup (cancel) must target THIS scheduler now
+        if live:
+            self.pending.appendleft(handle)
+        else:
+            self.pending.append(handle)
+        self.metrics.set_gauge("finchat_queue_depth", len(self.pending))
+        self._wakeup.set()
+        return True
+
+    def export_session(self, conversation_id: str | None) -> dict | None:
+        """Portable image of a conversation's session-cache entry for
+        cross-replica handoff (device pages dropped; see
+        SessionKVCache.export_entry)."""
+        if self.session_cache is None or not conversation_id:
+            return None
+        return self.session_cache.export_entry(conversation_id)
+
+    def import_session_entry(self, payload: dict | None) -> bool:
+        """Adopt a sibling's exported session-cache entry (drain handoff /
+        lazy route-time migration). The export carries no device pages —
+        an entry whose KV rode a shared-prefix head re-links against THIS
+        scheduler's own live registration of the same head (every fleet
+        replica registers the same prompt heads), refcounted exactly like
+        a local offload. No matching live head → the entry is refused
+        (counted) and the conversation resumes cold: KV positions are
+        absolute, so the snapshot's pages are meaningless without the
+        head KV below them."""
+        if payload is None or self.session_cache is None:
+            return False
+        prefix_len = int(payload["prefix_len"])
+        entry_ref = None
+        pages: list[int] = []
+        if prefix_len > 0:
+            page = self.engine.page_size
+            if prefix_len % page:
+                # fleet-LEVEL series: unlabeled like the rest of the
+                # finchat_fleet_* family (one reader sees all refusals)
+                METRICS.inc("finchat_fleet_session_import_refused_total")
+                return False
+            head_ids = [int(t) for t in payload["token_ids"][:prefix_len]]
+            for cand in self._prefixes:
+                if (not cand.retired and cand.shared_len >= prefix_len
+                        and cand.ids[:prefix_len] == head_ids):
+                    entry_ref = cand
+                    pages = cand.pages[: prefix_len // page]
+                    break
+            if entry_ref is None:
+                # fleet-LEVEL series: unlabeled like the rest of the
+                # finchat_fleet_* family (one reader sees all refusals)
+                METRICS.inc("finchat_fleet_session_import_refused_total")
+                return False
+            # reference BEFORE put (put may drop an older entry holding the
+            # same head — a momentary refs==0 would free it), exactly the
+            # _maybe_offload discipline
+            entry_ref.refs += 1
+        ok = self.session_cache.import_entry(
+            payload, prefix_entry=entry_ref, prefix_pages=pages
+        )
+        if not ok and entry_ref is not None:
+            entry_ref.refs -= 1
+            self._reap_prefixes()
+        return ok
+
+    def _drain_to_sink(self) -> int:
+        """Offer every pending handle — the just-preempted live streams
+        AND queued not-yet-admitted work — to the fleet drain sink,
+        together with its conversation's exported session-cache bytes.
+        Adopted handles leave this scheduler entirely. Runs BEFORE the
+        trip purges device-referencing caches (the export must still see
+        the entries). Parked/held overlap handles are skipped: their
+        extend_prompt seam is bound to this scheduler, and retrieval is
+        ms-scale — they replay locally. Returns how many were adopted."""
+        sink = self.drain_sink
+        if sink is None:
+            return 0
+        adopted = 0
+        for handle in list(self.pending):
+            if handle.held:
+                continue
+            payload = None
+            try:
+                payload = self.export_session(handle.conversation_id)
+            except Exception as e:
+                logger.error("session export failed for %s: %s",
+                             handle.conversation_id, e)
+            try:
+                taken = bool(sink(handle, payload))
+            except Exception as e:
+                logger.error("drain sink failed for %s: %s", handle.seq_id, e)
+                taken = False
+            if taken:
+                self.pending.remove(handle)
+                if handle.conversation_id and self.session_cache is not None:
+                    # the bytes moved with the stream; keeping the source
+                    # entry would let a later divergent turn resume stale
+                    self.session_cache.discard(handle.conversation_id)
+                adopted += 1
+        self.metrics.set_gauge("finchat_queue_depth", len(self.pending))
+        return adopted
+
+    def revive(self) -> bool:
+        """Supervisor respawn of a given-up replica: the breaker exhausted
+        its rebuild budget, the fleet drained this replica's streams to
+        siblings and marked it OUT; ``revive`` retries the device-state
+        rebuild from a clean slate so the router can bring the replica
+        back. Only callable with nothing live here (the drain emptied it).
+        Returns True when the engine is serving again."""
+        self._revive_prepare()
+        if not self._revive_rebuild():
+            return False
+        self._revive_commit()
+        return True
+
+    async def revive_async(self) -> bool:
+        """``revive`` with the device rebuild in a worker thread. The
+        rebuild reallocates the whole KV pool — seconds of device work at
+        real sizes — and the supervisor shares its event loop with every
+        SIBLING scheduler, so running it inline would freeze the exact
+        streams the drain just saved. Host bookkeeping stays on the loop
+        (asyncio futures must resolve there; the OUT replica receives no
+        routing, so its idle loop ticks observe only the consistent
+        post-prepare state while the thread rebuilds)."""
+        self._revive_prepare()
+        ok = await asyncio.to_thread(self._revive_rebuild)
+        if not ok:
+            return False
+        self._revive_commit()
+        return True
+
+    def _revive_prepare(self) -> None:
+        """Clean-slate host bookkeeping ahead of the rebuild. Idempotent —
+        the supervisor re-runs it on every backoff retry."""
+        if self.decoding or self.prefilling:
+            raise RuntimeError("revive() with live sequences; drain first")
+        for job in list(self._prefix_jobs):
+            # no device ops (a wedged device is why we're here, exactly
+            # the trip path's reasoning): the resets below reclaim the
+            # slot and pages wholesale, and the future must resolve
+            self._prefix_jobs.remove(job)
+            if not job.future.done():
+                job.future.set_result(0)
+        if self.session_cache is not None:
+            self.session_cache.discard_if(
+                lambda e: e.prefix_len > 0 or e.prefix_entry is not None
+            )
+        self._prefixes.clear()
+        self.allocator.reset()
+        self.free_slots = list(range(self.engine.engine_cfg.max_seqs))
+        self._temperature[:] = 0.0
+        self._top_p[:] = 1.0
+        self._top_k[:] = 0
+
+    def _revive_rebuild(self) -> bool:
+        """The device-only half (threadable: touches the engine, not
+        scheduler state)."""
+        try:
+            # armable site: a chaos drill wedging this replica's device
+            # keeps revive failing too (a broken device fails its rebuild),
+            # so the supervisor backs off instead of rejoining a replica
+            # that would immediately re-trip (bench --fleet-sweep)
+            inject("engine.rebuild", replica=self.replica_id)
+            with Timer(self.metrics, "finchat_engine_rebuild_seconds"):
+                self.engine.rebuild_device_state()
+        except Exception as e:
+            logger.error("revive: engine rebuild failed: %s", e)
+            return False
+        return True
+
+    def _revive_commit(self) -> None:
+        self.gave_up = False
+        self._rebuilds_without_success = 0
+        for bucket in self._fail_streaks:
+            self._fail_streaks[bucket] = 0
+        self._breaker_bucket = None
+        self._breaker_tripped_at = None
+        self.metrics.set_gauge("finchat_breaker_state", 0)
+        self.metrics.inc("finchat_engine_rebuilds_total")
+        for cb in list(self.on_rebuild):
+            try:
+                cb()
+            except Exception as e:
+                logger.error("on_rebuild callback failed: %s", e)
+        self._wakeup.set()
+
     def _round_failed(self, scope: str, error: str) -> None:
         """A whole-round dispatch failure — not attributable to one
         sequence. Breaker off (``breaker_threshold`` 0): legacy behavior,
@@ -1139,7 +1392,7 @@ class ContinuousBatchingScheduler:
         rebuilt. Dispatches are never re-consumed after a failure: a
         partially-consumed step cannot be told apart from an unconsumed
         one, and replay recomputes any undelivered token anyway."""
-        METRICS.inc("finchat_dispatch_failures_total")
+        self.metrics.inc("finchat_dispatch_failures_total")
         if self.breaker_threshold <= 0:
             if scope in ("prefill", "mixed"):
                 self._fail_prefill_round(error)
@@ -1176,12 +1429,12 @@ class ContinuousBatchingScheduler:
             self._rebuilds_without_success = 0
             self._breaker_bucket = None
             if self._breaker_tripped_at is not None:
-                METRICS.observe(
+                self.metrics.observe(
                     "finchat_breaker_recovery_seconds",
                     time.perf_counter() - self._breaker_tripped_at,
                 )
                 self._breaker_tripped_at = None
-                METRICS.set_gauge("finchat_breaker_state", 0)
+                self.metrics.set_gauge("finchat_breaker_state", 0)
 
     def _trip_breaker(self, bucket: str, error: str) -> None:
         """Breaker trip: preempt every live sequence to host, tear down
@@ -1198,17 +1451,60 @@ class ContinuousBatchingScheduler:
         self._breaker_bucket = bucket
         self._rebuilds_without_success += 1
         if self._rebuilds_without_success > self.breaker_max_rebuilds:
-            logger.error(
-                "breaker: %d consecutive rebuilds without a successful round; "
-                "failing in-flight streams (%s)",
-                self._rebuilds_without_success - 1, error,
-            )
-            for handle in list(self.decoding.values()) + list(self.prefilling):
-                try:
-                    self._evict(handle, "error", error=error)
-                except Exception as e:
-                    logger.error("evicting %s after breaker give-up: %s",
-                                 handle.seq_id, e)
+            if self.drain_sink is not None:
+                # fleet give-up (ISSUE 6): the streams survive on siblings
+                # — preempt every live sequence to host (prompt+generated
+                # kept on the handle) and hand it off, instead of failing
+                # it; whatever no sibling can adopt fails the legacy way
+                logger.error(
+                    "breaker: giving up after %d rebuilds; draining %d live "
+                    "sequences to sibling replicas (%s)",
+                    self._rebuilds_without_success - 1,
+                    len(self.decoding) + len(self.prefilling), error,
+                )
+                for handle in list(self.decoding.values()) + list(self.prefilling):
+                    try:
+                        self._preempt(handle, for_rebuild=True)
+                    except Exception as e:
+                        logger.error("preempting %s at breaker give-up: %s",
+                                     handle.seq_id, e)
+                self._drain_to_sink()
+                # whatever no sibling adopted — preempted live streams,
+                # parked holds, AND never-admitted queue entries — fails
+                # NOW with the retryable error: this scheduler is going
+                # OUT, and leaving queued work here would burn another
+                # full fail-streak cycle per handle against a known-wedged
+                # engine before its client hears anything
+                for handle in list(self.pending):
+                    self.pending.remove(handle)
+                    # the ONLY site counting drain failures — one increment
+                    # per stream the drain couldn't save (sink refusals stay
+                    # pending and land here; parked holds were never offered
+                    # but their streams fail all the same); fleet-LEVEL
+                    # series, unlabeled like the rest of finchat_fleet_*
+                    METRICS.inc("finchat_fleet_drain_failures_total")
+                    handle.finished = True
+                    handle.span.finish()
+                    handle.events.put_nowait({
+                        "type": "error", "message": error,
+                        "code": "replica_out", "retryable": True,
+                    })
+                # the queue is empty now — an OUT replica must not export
+                # phantom backlog for its whole OUT/RESPAWNING period
+                self.metrics.set_gauge("finchat_queue_depth",
+                                       len(self.pending))
+            else:
+                logger.error(
+                    "breaker: %d consecutive rebuilds without a successful "
+                    "round; failing in-flight streams (%s)",
+                    self._rebuilds_without_success - 1, error,
+                )
+                for handle in list(self.decoding.values()) + list(self.prefilling):
+                    try:
+                        self._evict(handle, "error", error=error)
+                    except Exception as e:
+                        logger.error("evicting %s after breaker give-up: %s",
+                                     handle.seq_id, e)
             for job in list(self._prefix_jobs):
                 try:  # slot + pages must come back even on give-up
                     self._fail_prefix_job(job)
@@ -1222,14 +1518,22 @@ class ContinuousBatchingScheduler:
             # _rebuilds_without_success deliberately persists, so another
             # trip without an intervening success gives up immediately
             self._breaker_tripped_at = None
-            METRICS.set_gauge("finchat_breaker_state", 0)
+            self.metrics.set_gauge("finchat_breaker_state", 0)
+            # the supervisor marks this replica OUT, reassigns its routing
+            # share, and respawns it in the background (revive)
+            self.gave_up = True
+            for cb in list(self.on_give_up):
+                try:
+                    cb()
+                except Exception as e:
+                    logger.error("on_give_up callback failed: %s", e)
             return
         logger.error("breaker tripped (%s): preempting %d live sequences and "
                      "rebuilding engine device state", error,
                      len(self.decoding) + len(self.prefilling))
         if self._breaker_tripped_at is None:
             self._breaker_tripped_at = time.perf_counter()
-        METRICS.set_gauge("finchat_breaker_state", 1)
+        self.metrics.set_gauge("finchat_breaker_state", 1)
         for handle in list(self.decoding.values()):
             self._preempt(handle, for_rebuild=True)
         for handle in list(self.prefilling):
@@ -1242,6 +1546,17 @@ class ContinuousBatchingScheduler:
             self._prefix_jobs.remove(job)
             if not job.future.done():
                 job.future.set_result(0)
+        # fleet drain-on-trip (ISSUE 6): hand the preempted streams — and
+        # their conversations' session-cache host bytes — to sibling
+        # replicas NOW, before the purge below drops the entries, so the
+        # streams continue elsewhere while this replica rebuilds instead
+        # of stalling behind the rebuild. Whatever no sibling adopts stays
+        # pending and replays here after the rebuild (PR 5 behavior).
+        if self.drain_sink is not None:
+            adopted = self._drain_to_sink()
+            if adopted:
+                logger.info("breaker drain: %d streams adopted by siblings",
+                            adopted)
         # caches referencing device pages reference a pool that no longer
         # exists: session entries with a referenced head are purged (their
         # on_drop releases the head refs), then the head entries drop
@@ -1261,7 +1576,7 @@ class ContinuousBatchingScheduler:
         self._top_p[:] = 1.0
         self._top_k[:] = 0
         try:
-            with Timer(METRICS, "finchat_engine_rebuild_seconds"):
+            with Timer(self.metrics, "finchat_engine_rebuild_seconds"):
                 self.engine.rebuild_device_state()
         except Exception as e:
             # rebuild itself failed (device gone?): fail what we hold and
@@ -1278,8 +1593,8 @@ class ContinuousBatchingScheduler:
             return
         for bucket in self._fail_streaks:
             self._fail_streaks[bucket] = 0
-        METRICS.inc("finchat_engine_rebuilds_total")
-        METRICS.set_gauge("finchat_breaker_state", 2)  # half-open
+        self.metrics.inc("finchat_engine_rebuilds_total")
+        self.metrics.set_gauge("finchat_breaker_state", 2)  # half-open
         for cb in list(self.on_rebuild):
             try:
                 cb()
@@ -1307,7 +1622,7 @@ class ContinuousBatchingScheduler:
             if self._parked(handle):
                 continue  # awaiting extend_prompt
             try:
-                inject("scheduler.prefill", seq_id=handle.seq_id)
+                inject("scheduler.prefill", seq_id=handle.seq_id, replica=self.replica_id)
                 if self._ring_routed(handle):
                     rc = eng.ring_segment_tokens()
                     if rc == 0:
@@ -1318,7 +1633,7 @@ class ContinuousBatchingScheduler:
                         # in-flight decode streams stall for the whole
                         # seq-sharded prefill — the latency trade the
                         # chunked path below exists to avoid
-                        with Timer(METRICS, "finchat_prefill_seconds"):
+                        with Timer(self.metrics, "finchat_prefill_seconds"):
                             ring_logits = eng.prefill_ring(handle.slot, handle.prompt_ids)
                         handle.prefill_pos = len(handle.prompt_ids)
                         completions.append((handle, ring_logits, handle.epoch))
@@ -1330,7 +1645,7 @@ class ContinuousBatchingScheduler:
                     # attention, engine.prefill_ring_segment)
                     handle.ring_path = True
                     seg = handle.prompt_ids[handle.prefill_pos : handle.prefill_pos + rc]
-                    with Timer(METRICS, "finchat_prefill_seconds"):
+                    with Timer(self.metrics, "finchat_prefill_seconds"):
                         seg_logits = eng.prefill_ring_segment(
                             handle.slot, seg, handle.prefill_pos
                         )
@@ -1354,7 +1669,7 @@ class ContinuousBatchingScheduler:
             rows += [(j.slot, j.ids, j.pos) for j in jobs]
             N = round_up_pow2(len(rows))
             tokens, slots, starts, n_valids = self._pack_prefill_rows(rows, N, C)
-            with Timer(METRICS, "finchat_prefill_seconds"):
+            with Timer(self.metrics, "finchat_prefill_seconds"):
                 # host-side dispatch time for the round (device work is
                 # async; steady-state it tracks the round cadence)
                 eng.state, logits = prefill_step(
@@ -1502,7 +1817,7 @@ class ContinuousBatchingScheduler:
             if self._parked(handle):
                 continue  # awaiting extend_prompt
             try:
-                inject("scheduler.prefill", seq_id=handle.seq_id)
+                inject("scheduler.prefill", seq_id=handle.seq_id, replica=self.replica_id)
             except Exception as e:  # per-sequence isolation, as in the split path
                 logger.error("prefill error for %s: %s", handle.seq_id, e)
                 self._evict(handle, "error", error=str(e))
@@ -1516,11 +1831,11 @@ class ContinuousBatchingScheduler:
         rows += [(j.slot, j.ids, j.pos) for j in jobs]
         if not rows or not decode_members:
             return  # a fault above drained one side; split paths resume next tick
-        inject("scheduler.decode")
+        inject("scheduler.decode", replica=self.replica_id)
         # mixed-specific armable site (ISSUE 5 satellite): targets ONLY the
         # unified dispatch, so tests can fail the fused round while the
         # split fallback paths stay healthy
-        inject("scheduler.mixed")
+        inject("scheduler.mixed", replica=self.replica_id)
         from finchat_tpu.engine.engine import round_up_pow2
 
         # chunk bucket: decode rows pay dense compute for every padded
@@ -1555,7 +1870,7 @@ class ContinuousBatchingScheduler:
             temp[i] = self._temperature[slot]
             top_p[i] = self._top_p[slot]
             top_k[i] = self._top_k[slot]
-        with Timer(METRICS, "finchat_mixed_step_seconds"):
+        with Timer(self.metrics, "finchat_mixed_step_seconds"):
             next_tokens = eng.mixed(
                 jnp.asarray(tokens), jnp.asarray(slots), jnp.asarray(starts),
                 jnp.asarray(n_valids), jnp.asarray(is_decode), jnp.asarray(arm),
@@ -1586,7 +1901,7 @@ class ContinuousBatchingScheduler:
             if handle.finished or handle.slot != slot or handle.epoch != epoch:
                 continue  # evicted/cancelled/preempted since dispatch
             self._deliver(handle, int(toks_host[base + d]))
-        METRICS.set_gauge("finchat_batch_occupancy", len(self.decoding))
+        self.metrics.set_gauge("finchat_batch_occupancy", len(self.decoding))
 
     def _deliver(self, handle: SequenceHandle, token_id: int) -> None:
         now = time.perf_counter()
@@ -1600,7 +1915,7 @@ class ContinuousBatchingScheduler:
             # iteration's prefill work — a step dispatched in steady
             # decode but delivered behind an admission's prefill round WAS
             # stretched by it, and must land in the "yes" series
-            METRICS.observe(
+            self.metrics.observe(
                 "finchat_inter_token_seconds", now - handle.last_token_at,
                 labels={"prefill_concurrent": "yes" if self._iter_ran_prefill else "no"},
             )
@@ -1610,7 +1925,7 @@ class ContinuousBatchingScheduler:
         handle.history.append(token_id)
         if handle.ngram_index is not None:
             handle.ngram_index.push(token_id)
-        METRICS.inc("finchat_tokens_generated_total")
+        self.metrics.inc("finchat_tokens_generated_total")
         if token_id == self.eos_id:
             self._evict(handle, "eos")
         elif handle.generated >= handle.sampling.max_new_tokens:
@@ -1627,7 +1942,7 @@ class ContinuousBatchingScheduler:
         grammar-constrained slots whose host-side pick from the previous
         step has not landed yet, so unconstrained streams keep the depth-2
         pipeline cadence while a tool decision is in flight."""
-        inject("scheduler.decode")
+        inject("scheduler.decode", replica=self.replica_id)
         eng = self.engine
         B = eng.engine_cfg.max_seqs
         active = np.zeros((B,), bool)
@@ -1706,7 +2021,7 @@ class ContinuousBatchingScheduler:
         loop-eligible slot. ``exclude`` slots (constrained picks still in
         flight) ride fully inactive, exactly as in _dispatch_decode;
         ``ahead`` is _undelivered() for the in-flight dispatch."""
-        inject("scheduler.decode")
+        inject("scheduler.decode", replica=self.replica_id)
         eng = self.engine
         B = eng.engine_cfg.max_seqs
         ahead = ahead or {}
@@ -1728,8 +2043,8 @@ class ContinuousBatchingScheduler:
             jnp.asarray(self._top_k),
             eos_id=self.eos_id,
         )
-        METRICS.inc("finchat_decode_loop_blocks_total")
-        METRICS.set_gauge("finchat_decode_loop_demoted_slots", len(demoted))
+        self.metrics.inc("finchat_decode_loop_blocks_total")
+        self.metrics.set_gauge("finchat_decode_loop_demoted_slots", len(demoted))
         step = None
         if demoted:
             # demoted slots advance one token via the plain step — exclude
@@ -1766,10 +2081,10 @@ class ContinuousBatchingScheduler:
                     wasted += K - i - 1
                     break
         if wasted:
-            METRICS.inc("finchat_decode_loop_wasted_tail_tokens_total", wasted)
+            self.metrics.inc("finchat_decode_loop_wasted_tail_tokens_total", wasted)
         if blk.step is not None:
             await self._consume_step(blk.step)
-        METRICS.set_gauge("finchat_batch_occupancy", len(self.decoding))
+        self.metrics.set_gauge("finchat_batch_occupancy", len(self.decoding))
 
     @staticmethod
     def _spec_eligible(handle: SequenceHandle) -> bool:
@@ -1815,7 +2130,7 @@ class ContinuousBatchingScheduler:
         if self._spec_miss_streak >= self.SPEC_MISS_DEMOTE:
             self._spec_miss_streak = 0
             self._spec_cooldown = self.SPEC_RETRY_EVERY
-            METRICS.inc("finchat_spec_demotions_total")
+            self.metrics.inc("finchat_spec_demotions_total")
 
     async def _run_spec_step(self) -> None:
         """One speculative verify step: propose drafts from each greedy
@@ -1828,7 +2143,7 @@ class ContinuousBatchingScheduler:
 
         if not self.decoding:
             return  # consuming the drained pipeline step may have evicted all
-        inject("scheduler.decode")
+        inject("scheduler.decode", replica=self.replica_id)
         eng = self.engine
         B = eng.engine_cfg.max_seqs
         Kd = self.spec_k
@@ -1894,9 +2209,9 @@ class ContinuousBatchingScheduler:
                 if handle.finished:  # EOS / length inside the prefix
                     break
         if accepted_total:
-            METRICS.inc("finchat_spec_tokens_accepted_total", accepted_total)
+            self.metrics.inc("finchat_spec_tokens_accepted_total", accepted_total)
         self._spec_note_step(accepted=accepted_total)
-        METRICS.set_gauge("finchat_batch_occupancy", len(self.decoding))
+        self.metrics.set_gauge("finchat_batch_occupancy", len(self.decoding))
 
     async def _consume_step(self, step: _InFlightStep) -> None:
         """Fetch a dispatched step's tokens (in a worker thread, so the event
@@ -1919,7 +2234,7 @@ class ContinuousBatchingScheduler:
                 self._deliver(handle, token)
             else:
                 self._deliver(handle, int(tokens_host[slot]))
-        METRICS.set_gauge("finchat_batch_occupancy", len(self.decoding))
+        self.metrics.set_gauge("finchat_batch_occupancy", len(self.decoding))
 
     def _pending_constrained(self, inflight) -> set[int]:
         """Constrained slots whose host-side pick lands only when
@@ -2007,7 +2322,7 @@ class ContinuousBatchingScheduler:
             # coexist are exactly where the mixed step's 2→1 fusion applies
             self._iter_ran_prefill = prefill_active
             if prefill_active and self.decoding:
-                METRICS.inc("finchat_coexist_iterations_total")
+                self.metrics.inc("finchat_coexist_iterations_total")
 
             if self._spec_cooldown > 0:
                 # demoted after sustained all-miss steps: count pipelined

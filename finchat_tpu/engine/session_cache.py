@@ -55,6 +55,28 @@ from finchat_tpu.utils.metrics import METRICS
 
 logger = get_logger(__name__)
 
+# Cache-key convention, shared across layers: the agent keys each LLM
+# role's entry separately (the two roles render DIFFERENT prompts for one
+# conversation, so a shared key would cross-truncate every turn), and the
+# fleet router must map any such key back to the conversation it belongs
+# to — routing and migration are per-CONVERSATION, entries are per-ROLE.
+SESSION_KEY_ROLES = ("tool", "resp")
+
+
+def session_key(conversation_id: str, role: str) -> str:
+    """The session-cache key for one LLM role of a conversation."""
+    return f"{conversation_id}#{role}"
+
+
+def conversation_of(key: str) -> str:
+    """Inverse of :func:`session_key` for routing: the conversation a
+    cache key (or a handle's ``conversation_id``) belongs to. Keys without
+    a recognised role suffix — direct scheduler submissions, benches —
+    are their own conversation."""
+    base, sep, role = key.rpartition("#")
+    return base if sep and role in SESSION_KEY_ROLES else key
+
+
 # Snapshot layout throughout this module: a (k, v, k_scales | None,
 # v_scales | None) tuple of host arrays, each [L, n_pages, ...] — the
 # gather_pages_host / scatter_pages_device contract (engine/kv_cache.py).
@@ -138,11 +160,15 @@ class SessionKVCache:
     """
 
     def __init__(self, budget_bytes: int, page_size: int,
-                 on_drop: Callable[[SessionEntry], None] | None = None):
+                 on_drop: Callable[[SessionEntry], None] | None = None,
+                 metrics=None):
         assert budget_bytes > 0 and page_size > 0
         self.budget_bytes = budget_bytes
         self.page_size = page_size
         self._on_drop = on_drop
+        # a fleet replica passes METRICS.labeled(replica=...) so its cache
+        # series separate from its siblings'; default is the global registry
+        self.metrics = metrics if metrics is not None else METRICS
         self._entries: OrderedDict[str, SessionEntry] = OrderedDict()
         self._resident_bytes = 0
         self._publish_gauges()
@@ -159,8 +185,8 @@ class SessionKVCache:
         return self._entries.get(conversation_id)
 
     def _publish_gauges(self) -> None:
-        METRICS.set_gauge("finchat_session_cache_resident_bytes", self._resident_bytes)
-        METRICS.set_gauge("finchat_session_cache_entries", len(self._entries))
+        self.metrics.set_gauge("finchat_session_cache_resident_bytes", self._resident_bytes)
+        self.metrics.set_gauge("finchat_session_cache_entries", len(self._entries))
 
     # --- write path ------------------------------------------------------
     def put(self, entry: SessionEntry) -> bool:
@@ -182,7 +208,7 @@ class SessionKVCache:
             victim_id, victim = next(iter(self._entries.items()))
             del self._entries[victim_id]
             self._drop(victim)
-            METRICS.inc("finchat_session_cache_evictions_total")
+            self.metrics.inc("finchat_session_cache_evictions_total")
             logger.debug("session cache: evicted %s (LRU, %d bytes)",
                          victim_id, victim.nbytes)
         self._publish_gauges()
@@ -220,6 +246,48 @@ class SessionKVCache:
         if self._on_drop is not None:
             self._on_drop(entry)
 
+    # --- cross-replica migration (serve/fleet.py; ISSUE 6) ---------------
+    def export_entry(self, conversation_id: str) -> dict | None:
+        """Portable, device-independent image of one conversation's entry
+        for cross-replica handoff: token ids + the host snapshot arrays.
+        The referenced shared-prefix DEVICE pages are NOT exportable — the
+        payload carries only ``prefix_len`` (the head's tokens are
+        ``token_ids[:prefix_len]``) so the importer can re-link against
+        its OWN live registration of the same head
+        (scheduler ``import_session_entry``). Snapshot arrays are shared
+        by reference, never mutated in place (truncation replaces them),
+        so export is O(1) — no host memcpy of the KV bytes. The entry
+        stays resident here; the caller discards it once adopted."""
+        entry = self._entries.get(conversation_id)
+        if entry is None or entry.n_tokens == 0:
+            return None
+        return {
+            "conversation_id": conversation_id,
+            "token_ids": np.array(entry.token_ids, copy=True),
+            "prefix_len": int(entry.prefix_len),
+            "snap": entry.snap,
+        }
+
+    def import_entry(self, payload: dict, *, prefix_entry: Any | None = None,
+                     prefix_pages: list[int] | None = None) -> bool:
+        """Adopt an exported entry. ``prefix_entry``/``prefix_pages`` is
+        the importer's OWN live twin of the exported shared head —
+        resolved, validated, and refcounted by the scheduler — covering
+        exactly ``payload['prefix_len']`` tokens; both empty only when
+        the payload has no head. Returns ``put``'s verdict (the caller
+        un-references the head on False, mirroring ``_maybe_offload``)."""
+        prefix_len = int(payload["prefix_len"])
+        assert (prefix_len == 0) == (prefix_entry is None)
+        entry = SessionEntry(
+            conversation_id=payload["conversation_id"],
+            token_ids=np.asarray(payload["token_ids"], np.int32),
+            prefix_entry=prefix_entry,
+            prefix_pages=list(prefix_pages or []),
+            prefix_len=prefix_len,
+            snap=payload["snap"],
+        )
+        return self.put(entry)
+
     # --- read path -------------------------------------------------------
     def match(self, conversation_id: str, prompt_ids: list[int]) -> tuple[SessionEntry | None, int]:
         """Longest resumable prefix of ``prompt_ids`` held for this
@@ -255,7 +323,7 @@ class SessionKVCache:
         """Cut an entry down to a page-aligned token count (divergence).
         An entry truncated to nothing is dropped entirely."""
         assert n_tokens % self.page_size == 0 and n_tokens <= entry.n_tokens
-        METRICS.inc("finchat_session_cache_truncations_total")
+        self.metrics.inc("finchat_session_cache_truncations_total")
         before = entry.nbytes
         entry.token_ids = entry.token_ids[:n_tokens]
         if n_tokens <= entry.prefix_len:

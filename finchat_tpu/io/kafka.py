@@ -48,6 +48,31 @@ except ImportError:  # pragma: no cover - depends on image
     HAVE_CONFLUENT = False
 
 
+DEFAULT_NUM_PARTITIONS = 4
+
+
+def partition_for_key(key: str | None, num_partitions: int = DEFAULT_NUM_PARTITIONS) -> int:
+    """THE key→partition placement function: CRC32 of the message key mod
+    the partition count. One definition shared by the memory broker's
+    produce path and the fleet router (serve/fleet.py), so conversation→
+    replica routing is aligned with Kafka partition assignment BY
+    CONSTRUCTION — every conversation of one partition routes to one
+    replica, and a replica's routing share is exactly a set of partitions
+    a consumer-group assignment could mirror.
+
+    CAVEAT (confluent backend): CRC32 is librdkafka's ``consistent``
+    partitioner, NOT the Java client's default (murmur2) — messages
+    produced by Java/KStreams services land on murmur2 partitions, which
+    silently breaks the routing≡assignment alignment (affinity degrades
+    to permanent cold resumes; nothing is incorrect, just slow). Either
+    configure upstream Java producers with a CRC32-compatible
+    partitioner, or accept partition-level affinity only for traffic
+    produced through clients using ``consistent``."""
+    if key is None:
+        return 0
+    return zlib.crc32(key.encode()) % num_partitions
+
+
 class Message:
     """Consumer record with the confluent-kafka ``Message`` read surface the
     app uses: ``value()`` / ``key()`` / ``topic()`` / ``error()``."""
@@ -121,7 +146,7 @@ class InMemoryBroker:
     """In-process broker: topics × partitions, consumer groups, committed
     offsets. Thread-safe; shared by all clients in a process."""
 
-    def __init__(self, num_partitions: int = 4):
+    def __init__(self, num_partitions: int = DEFAULT_NUM_PARTITIONS):
         self.num_partitions = num_partitions
         self._lock = threading.Lock()
         self._topics: dict[str, list[_PartitionLog]] = {}
@@ -129,9 +154,7 @@ class InMemoryBroker:
         self.faults = FaultInjection()
 
     def _partition_for(self, key: str | None) -> int:
-        if key is None:
-            return 0
-        return zlib.crc32(key.encode()) % self.num_partitions
+        return partition_for_key(key, self.num_partitions)
 
     def _ensure_topic(self, topic: str) -> list[_PartitionLog]:
         if topic not in self._topics:
@@ -226,13 +249,16 @@ _PROCESS_BROKER: InMemoryBroker | None = None
 _PROCESS_BROKER_LOCK = threading.Lock()
 
 
-def default_broker() -> InMemoryBroker:
+def default_broker(num_partitions: int = DEFAULT_NUM_PARTITIONS) -> InMemoryBroker:
     """Process-wide shared broker for the memory backend, so independently
-    constructed producers and consumers in one process see each other."""
+    constructed producers and consumers in one process see each other.
+    ``num_partitions`` applies only when THIS call creates the broker
+    (kafka.num_partitions, via the first KafkaClient); later callers share
+    it as-is — a mismatch warns at client construction."""
     global _PROCESS_BROKER
     with _PROCESS_BROKER_LOCK:
         if _PROCESS_BROKER is None:
-            _PROCESS_BROKER = InMemoryBroker()
+            _PROCESS_BROKER = InMemoryBroker(num_partitions)
         return _PROCESS_BROKER
 
 
@@ -256,9 +282,15 @@ class KafkaClient:
             self._producer = confluent_kafka.Producer(self.config.librdkafka_config())
             self._consumer = None
         else:
-            self._broker = broker or default_broker()
+            self._broker = broker or default_broker(self.config.num_partitions)
             self._producer = None
             self._consumer = None
+            if self._broker.num_partitions != self.config.num_partitions:
+                logger.warning(
+                    "kafka: broker has %d partitions but kafka.num_partitions"
+                    " is %d; using the broker's count for routing",
+                    self._broker.num_partitions, self.config.num_partitions,
+                )
 
     # --- consumer -------------------------------------------------------
     def setup_consumer(self, topics: list[str] | None = None) -> None:
@@ -299,6 +331,21 @@ class KafkaClient:
         except Exception as e:
             logger.error("Error in message consumption: %s", e)
             return None
+
+    @property
+    def num_partitions(self) -> int:
+        """Partitions per topic — the fleet router's routing-unit count.
+        The memory broker reports its exact count; the confluent backend
+        trusts ``kafka.num_partitions``, which MUST match how the real
+        topics were created or the routing ≡ partition-assignment
+        alignment silently breaks (see KafkaConfig.num_partitions)."""
+        return (self._broker.num_partitions if self._broker is not None
+                else self.config.num_partitions)
+
+    def partition_for(self, key: str) -> int:
+        """The partition this client's broker places ``key`` on — the
+        routing unit the fleet router hashes to a replica."""
+        return partition_for_key(key, self.num_partitions)
 
     def commit_offset(self, topic: str, partition: int, next_offset: int) -> None:
         """Commit a partition's resume offset (manual-commit mode; no-op

@@ -34,7 +34,6 @@ from __future__ import annotations
 import asyncio
 import json
 import time
-from collections import deque
 from pathlib import Path
 
 import jax
@@ -54,6 +53,7 @@ from finchat_tpu.io.schemas import (
 )
 from finchat_tpu.io.store import ConversationStore, make_store
 from finchat_tpu.models.llama import PRESETS, init_params
+from finchat_tpu.serve.fleet import LIVE, DedupeRing, EngineFleet, EngineReplica
 from finchat_tpu.models.tokenizer import get_tokenizer
 from finchat_tpu.serve.http import HTTPServer, Request, Response, StreamingResponse, sse_event
 from finchat_tpu.tools.retrieval import TransactionRetriever
@@ -228,26 +228,56 @@ async def _prefix_refresh_loop(app: "App") -> None:
     """Periodic freshness checker for the shared-prefix cache. The check
     itself is a few rendered-string comparisons; actual re-registration
     happens at most once a day (date rollover) and runs chunked through
-    the scheduler loop."""
+    the scheduler loop. With a fleet, every LIVE replica is checked —
+    registration is per device state."""
     while app._running:
         try:
-            await _maybe_refresh_prefix_cache(app)
+            for target in app._prefix_targets():
+                await _maybe_refresh_prefix_cache(target)
         except Exception as e:  # best-effort: the cache is an optimization
             logger.error("prefix cache refresh error: %s", e)
         await asyncio.sleep(app._prefix_refresh_check_s)
 
 
-def build_generators(cfg: AppConfig) -> tuple[TextGenerator, TextGenerator, ContinuousBatchingScheduler | None, object]:
-    """Construct (tool_generator, response_generator, scheduler, tokenizer).
+class _ReplicaPrefixView:
+    """Adapter giving ``_maybe_refresh_prefix_cache`` a per-replica
+    target: the single-engine App attribute surface, with the registered
+    head set stored ON the replica (shared-head prefill lives in that
+    replica's device state, so each replica tracks its own)."""
 
-    ``model.preset == "stub"`` wires canned generators (dev/no-TPU); anything
-    else builds the TPU engine with one shared continuous-batching scheduler
-    serving both agent roles.
-    """
-    if cfg.model.preset == "stub":
-        stub = StubGenerator(default="I'm Penny, here to help with your finances.")
-        return stub, stub, None, get_tokenizer()
+    def __init__(self, app: "App", rep: EngineReplica):
+        self._rep = rep
+        self._prefix_cache_enabled = app._prefix_cache_enabled
+        self.scheduler = rep.scheduler
+        self.agent = rep.agent
 
+    @property
+    def _registered_heads(self) -> set:
+        return self._rep.registered_heads
+
+    @_registered_heads.setter
+    def _registered_heads(self, value: set) -> None:
+        self._rep.registered_heads = set(value)
+
+
+def _make_rebuild_hook(rep: EngineReplica):
+    """on_rebuild callback for one fleet replica: the rebuild dropped that
+    replica's prefilled heads, so mark them unregistered there (the
+    refresh loop re-registers through the chunked path). Keyed so App.start
+    can keep the hook idempotent across restarts."""
+
+    def hook() -> None:
+        rep.registered_heads.clear()
+
+    hook.key = ("fleet_heads", rep.replica_id)
+    return hook
+
+
+def _load_model_artifacts(cfg: AppConfig) -> tuple:
+    """Load everything the engine replicas SHARE — (model config, params,
+    tokenizer, mesh). The params tree is immutable jax arrays, so a fleet
+    of N replicas costs N KV pools and schedulers, not N copies of the
+    weights."""
     config = PRESETS[cfg.model.preset]
     if cfg.model.dtype:
         import dataclasses
@@ -284,13 +314,42 @@ def build_generators(cfg: AppConfig) -> tuple[TextGenerator, TextGenerator, Cont
     # on a multi-chip host, and a 4-chip mesh config works on an 8-chip host)
     n_mesh = jax.device_count() if -1 in sizes else fixed
     mesh = build_mesh(spec, devices=jax.devices()[:n_mesh]) if n_mesh > 1 else None
+    return config, params, tokenizer, mesh
+
+
+def make_engine_replica(
+    cfg: AppConfig, artifacts: tuple, replica_id: str | None = None
+) -> tuple[EngineGenerator, ContinuousBatchingScheduler]:
+    """One engine replica over the shared artifacts: its own KV page pool
+    (InferenceEngine device state), scheduler, and session cache. A
+    ``replica_id`` routes the scheduler's metrics through a labeled view
+    (every metric family per replica) and stamps its fault-injection
+    sites."""
+    config, params, tokenizer, mesh = artifacts
+    metrics = METRICS.labeled(replica=replica_id) if replica_id is not None else None
     engine = InferenceEngine(config, params, cfg.engine, mesh=mesh,
                              quant=cfg.model.quant)
     if cfg.engine.warmup_on_start:
         engine.warmup()
-    scheduler = ContinuousBatchingScheduler(engine, eos_id=tokenizer.eos_id)
-    generator = EngineGenerator(scheduler, tokenizer)
-    return generator, generator, scheduler, tokenizer
+    scheduler = ContinuousBatchingScheduler(
+        engine, eos_id=tokenizer.eos_id, metrics=metrics, replica_id=replica_id
+    )
+    return EngineGenerator(scheduler, tokenizer), scheduler
+
+
+def build_generators(cfg: AppConfig) -> tuple[TextGenerator, TextGenerator, ContinuousBatchingScheduler | None, object]:
+    """Construct (tool_generator, response_generator, scheduler, tokenizer).
+
+    ``model.preset == "stub"`` wires canned generators (dev/no-TPU); anything
+    else builds the TPU engine with one shared continuous-batching scheduler
+    serving both agent roles.
+    """
+    if cfg.model.preset == "stub":
+        stub = StubGenerator(default="I'm Penny, here to help with your finances.")
+        return stub, stub, None, get_tokenizer()
+    artifacts = _load_model_artifacts(cfg)
+    generator, scheduler = make_engine_replica(cfg, artifacts)
+    return generator, generator, scheduler, artifacts[2]
 
 
 class App:
@@ -298,12 +357,18 @@ class App:
 
     def __init__(self, cfg: AppConfig, *, agent: LLMAgent, store: ConversationStore,
                  kafka: KafkaClient, scheduler: ContinuousBatchingScheduler | None = None,
-                 retriever: TransactionRetriever | None = None):
+                 retriever: TransactionRetriever | None = None,
+                 fleet: EngineFleet | None = None):
         self.cfg = cfg
         self.agent = agent
         self.store = store
         self.kafka = kafka
         self.scheduler = scheduler
+        # engine fleet (serve/fleet.py; ISSUE 6): when set, every chat path
+        # routes its conversation to a replica via _agent_for — ``agent``/
+        # ``scheduler`` remain replica 0's for the single-engine surface
+        # (tests, dev) and are managed THROUGH the fleet lifecycle
+        self.fleet = fleet
         self.retriever = retriever
         self.server = HTTPServer(cfg.serve.host, cfg.serve.port)
         self.server.route("GET", "/health", self.health)
@@ -337,8 +402,14 @@ class App:
         self._commit_enabled = cfg.kafka.commit_after_process
         self._done_offsets: dict[tuple[str, int], set[int]] = {}
         self._commit_next: dict[tuple[str, int], int] = {}
-        self._seen_ids: set = set()
-        self._seen_ring: deque = deque()
+        # answered-message_id dedupe lives at the ROUTER level (the fleet's
+        # ring when one exists): a replica crash plus Kafka redelivery to a
+        # sibling replica consults the same ring the original answer was
+        # recorded in, so it cannot double-answer (ISSUE 6 satellite —
+        # closes the per-replica hole PR 5 documented)
+        # ring size's single source of truth is the DedupeRing default,
+        # so the fleet's shared ring and this one can never drift
+        self._dedupe = fleet.dedupe if fleet is not None else DedupeRing()
 
     # --- lifespan -------------------------------------------------------
     def _embed_batcher(self):
@@ -360,7 +431,19 @@ class App:
         if self.retriever is not None:
             topics.append(TRANSACTION_UPSERT_TOPIC)
         self.kafka.setup_consumer(topics=topics)
-        if self.scheduler is not None:
+        if self.fleet is not None:
+            # per-replica head bookkeeping: a rebuild drops that replica's
+            # prefilled heads only; the refresh loop re-registers them
+            # per replica, and a supervisor respawn re-registers eagerly
+            for rep in self.fleet.replicas:
+                hook = _make_rebuild_hook(rep)
+                if hook.key not in {getattr(cb, "key", None)
+                                    for cb in rep.scheduler.on_rebuild}:
+                    rep.scheduler.on_rebuild.append(hook)
+            if self._respawn_heads not in self.fleet.on_respawn:
+                self.fleet.on_respawn.append(self._respawn_heads)
+            await self.fleet.start()
+        elif self.scheduler is not None:
             if self._on_engine_rebuild not in self.scheduler.on_rebuild:
                 self.scheduler.on_rebuild.append(self._on_engine_rebuild)
             await self.scheduler.start()
@@ -392,7 +475,9 @@ class App:
         batcher = self._embed_batcher()
         if batcher is not None:
             await batcher.close()
-        if self.scheduler is not None:
+        if self.fleet is not None:
+            await self.fleet.stop()
+        elif self.scheduler is not None:
             await self.scheduler.stop()
         self._persist_index(force=True)
         await self.server.stop()
@@ -427,6 +512,31 @@ class App:
         multi-second head prefill."""
         self._registered_heads = set()
 
+    # --- fleet routing (serve/fleet.py; ISSUE 6) ------------------------
+    def _agent_for(self, conversation_id: str) -> LLMAgent:
+        """The agent serving this conversation: the fleet's
+        conversation-affinity route (which also migrates the session-cache
+        bytes home) with a fleet, the single agent otherwise."""
+        if self.fleet is not None:
+            return self.fleet.agent_for(conversation_id)
+        return self.agent
+
+    def _prefix_targets(self) -> list:
+        """Per-scheduler shared-prefix refresh targets (one per LIVE
+        replica with a fleet; the app itself single-engine)."""
+        if self.fleet is not None:
+            return [_ReplicaPrefixView(self, rep) for rep in self.fleet.replicas
+                    if rep.state == LIVE and rep.agent is not None]
+        return [self]
+
+    async def _respawn_heads(self, rep: EngineReplica) -> None:
+        """fleet.on_respawn hook: re-register the shared prompt heads on a
+        just-revived replica EAGERLY (the periodic refresh would leave it
+        serving head-cold for up to a refresh interval)."""
+        if self._prefix_cache_enabled and rep.agent is not None:
+            rep.registered_heads = set()
+            await _maybe_refresh_prefix_cache(_ReplicaPrefixView(self, rep))
+
     def _request_deadline(self, wall_anchor_s: float | None = None) -> float | None:
         """Per-request deadline on the scheduler's monotonic clock, or
         None when ``engine.request_deadline_seconds`` is unset. Anchored at
@@ -452,7 +562,8 @@ class App:
         return ts_ms / 1000.0
 
     # --- at-least-once commit plumbing (kafka.commit_after_process) ------
-    DEDUPE_RING_SIZE = 1024
+    # (dedupe ring size lives on serve/fleet.py DedupeRing — one default
+    # for the single-engine ring and the fleet-shared ring alike)
 
     def _note_message_polled(self, msg) -> None:
         """Anchor the partition's commit watermark at the FIRST polled
@@ -486,14 +597,14 @@ class App:
     def _seen_message_id(self, message_id) -> bool:
         """Bounded dedupe ring over inbound ``message_id``s: True when this
         id was already handled this process lifetime (redelivery after a
-        crash/rebalance must not double-answer)."""
-        if message_id in self._seen_ids:
-            return True
-        self._seen_ids.add(message_id)
-        self._seen_ring.append(message_id)
-        if len(self._seen_ring) > self.DEDUPE_RING_SIZE:
-            self._seen_ids.discard(self._seen_ring.popleft())
-        return False
+        crash/rebalance must not double-answer). Shared fleet-wide — see
+        serve/fleet.py DedupeRing."""
+        return self._dedupe.seen(message_id)
+
+    @property
+    def _seen_ids(self) -> set:
+        """Introspection view of the dedupe ring's id set (tests)."""
+        return self._dedupe._ids
 
     # --- conversation plumbing ------------------------------------------
     @staticmethod
@@ -541,7 +652,15 @@ class App:
         conversation_id, user_id, user_context, chat_history = (
             await self._conversation_inputs(payload)
         )
-        result = await self.agent.query(
+        try:
+            agent = self._agent_for(conversation_id)
+        except RuntimeError:
+            # whole fleet out: same retryable signal the Kafka path emits
+            return Response.json(
+                {"detail": "no live engine replica; retry with backoff",
+                 "retryable": True}, status=503,
+            )
+        result = await agent.query(
             payload["message"], user_id, user_context, chat_history,
             conversation_id=conversation_id,
             deadline=self._request_deadline(),
@@ -565,9 +684,16 @@ class App:
         )
 
         deadline = self._request_deadline()
+        try:
+            agent = self._agent_for(conversation_id)
+        except RuntimeError:
+            return Response.json(
+                {"detail": "no live engine replica; retry with backoff",
+                 "retryable": True}, status=503,
+            )
 
         async def events():
-            updates = self.agent.stream_with_status(
+            updates = agent.stream_with_status(
                 payload["message"], user_id, user_context, chat_history,
                 conversation_id=conversation_id, deadline=deadline,
             )
@@ -655,11 +781,23 @@ class App:
                 )
                 logger.debug("Processed chunk: %s", text)
 
+        try:
+            agent = self._agent_for(conversation_id)
+        except RuntimeError as e:
+            # whole fleet out: the client gets a retryable error instead of
+            # a silent drop (the dedupe ring forgets the id — see _done)
+            logger.error("no replica for conversation %s: %s", conversation_id, e)
+            self.kafka.produce_error_message(
+                AI_RESPONSE_TOPIC, conversation_id,
+                error_chunk(message_value, code="overloaded", retryable=True),
+            )
+            return False
+
         # deadline anchored at the PRODUCER timestamp: broker queueing time
         # counts against the allowance, so a message that sat through a
         # backlog sheds (structured retryable error) instead of burning
         # prefill compute on an answer its client gave up on
-        updates = self.agent.stream_with_status(
+        updates = agent.stream_with_status(
             msg, user_id, context, chat_history, conversation_id=conversation_id,
             deadline=self._request_deadline(self._message_wall_ts(message)),
         )
@@ -821,22 +959,29 @@ class App:
                 not t.cancelled() and t.exception() is None and bool(t.result())
             )
             if mid is not None and not answered:
-                # never answered: drop the id so a producer retry (the
-                # retryable error chunk's invitation) is reprocessed. The
-                # ring entry goes too — a stale duplicate left in the deque
-                # would, on overflow, discard the RE-ADDED (answered) id
-                # from the set long before 1024 newer ids passed
-                self._seen_ids.discard(mid)
-                try:
-                    self._seen_ring.remove(mid)
-                except ValueError:
-                    pass
+                # never answered: drop the id (set AND ring slot) so a
+                # producer retry (the retryable error chunk's invitation)
+                # is reprocessed instead of black-holed
+                self._dedupe.forget(mid)
             # the watchdog-wrapped handler completed (answered, errored, or
             # timed out with the timeout chunk emitted): only now may this
             # offset count toward the committed watermark
             self._note_message_done(msg)
 
         task.add_done_callback(_done)
+
+    def _max_inflight(self) -> int:
+        """Poll-gate bound: a full batch per LIVE replica. OUT/RESPAWNING
+        replicas are not capacity — counting them would keep this worker
+        claiming messages sized for the whole fleet during an outage,
+        load the survivors must absorb instead of the consumer group
+        redistributing it. Floored at one batch so a whole-fleet-out
+        window still polls (each message gets its structured retryable
+        error instead of rotting unclaimed on the partition)."""
+        n_replicas = 1
+        if self.fleet is not None:
+            n_replicas = max(1, len(self.fleet.live_replicas()))
+        return max(self.cfg.engine.max_seqs, 1) * n_replicas
 
     async def consume_messages(self) -> None:
         """Poll Kafka and fan messages out as concurrent tasks — MANY
@@ -846,10 +991,9 @@ class App:
         polling while a full batch's worth of messages is already in
         flight, so the broker's consumer group redistributes load instead
         of this worker hoarding it."""
-        max_inflight = max(self.cfg.engine.max_seqs, 1)
         while self._running:
             try:
-                if len(self._inflight) >= max_inflight:
+                if len(self._inflight) >= self._max_inflight():
                     await asyncio.wait(
                         set(self._inflight), return_when=asyncio.FIRST_COMPLETED
                     )
@@ -896,10 +1040,27 @@ def build_app(cfg: AppConfig | None = None, *, store: ConversationStore | None =
 
     scheduler = None
     tokenizer = None
+    fleet_replicas: list[EngineReplica] | None = None
     if tool_generator is None or response_generator is None:
-        tool_gen, resp_gen, scheduler, tokenizer = build_generators(cfg)
-        tool_generator = tool_generator or tool_gen
-        response_generator = response_generator or resp_gen
+        if cfg.fleet.replicas > 1 and cfg.model.preset != "stub":
+            # engine fleet (ISSUE 6): N replicas over ONE shared weights
+            # tree, each with its own KV pool, scheduler, session cache,
+            # and replica-labeled metrics; agents bind per replica below
+            artifacts = _load_model_artifacts(cfg)
+            tokenizer = artifacts[2]
+            fleet_replicas = []
+            for i in range(cfg.fleet.replicas):
+                gen, sched = make_engine_replica(cfg, artifacts, replica_id=str(i))
+                fleet_replicas.append(
+                    EngineReplica(replica_id=str(i), scheduler=sched, generator=gen)
+                )
+            scheduler = fleet_replicas[0].scheduler
+            tool_generator = tool_generator or fleet_replicas[0].generator
+            response_generator = response_generator or fleet_replicas[0].generator
+        else:
+            tool_gen, resp_gen, scheduler, tokenizer = build_generators(cfg)
+            tool_generator = tool_generator or tool_gen
+            response_generator = response_generator or resp_gen
 
     if retriever is None:
         from finchat_tpu.embed.batcher import EmbedMicrobatcher
@@ -967,20 +1128,40 @@ def build_app(cfg: AppConfig | None = None, *, store: ConversationStore | None =
             )
 
     system_prompt, tool_prompt = load_prompts()
-    agent = LLMAgent(
-        tool_generator, response_generator, retriever, system_prompt, tool_prompt,
-        response_sampling=SamplingParams(
-            temperature=cfg.engine.temperature, top_p=cfg.engine.top_p,
-            top_k=cfg.engine.top_k, max_new_tokens=cfg.engine.max_new_tokens,
-        ),
-        retrieval_overlap=cfg.engine.retrieval_overlap,
-    )
+
+    def make_agent(tool_gen, resp_gen) -> LLMAgent:
+        return LLMAgent(
+            tool_gen, resp_gen, retriever, system_prompt, tool_prompt,
+            response_sampling=SamplingParams(
+                temperature=cfg.engine.temperature, top_p=cfg.engine.top_p,
+                top_k=cfg.engine.top_k, max_new_tokens=cfg.engine.max_new_tokens,
+            ),
+            retrieval_overlap=cfg.engine.retrieval_overlap,
+        )
+
+    agent = make_agent(tool_generator, response_generator)
+    fleet = None
+    if fleet_replicas is not None:
+        # one agent per replica (prompts + retriever shared; each agent's
+        # generators are bound to its replica's scheduler); replica 0
+        # reuses the agent above so App.agent and the fleet stay one object
+        fleet_replicas[0].agent = agent
+        for rep in fleet_replicas[1:]:
+            rep.agent = make_agent(rep.generator, rep.generator)
+        fleet = EngineFleet(fleet_replicas, cfg.fleet,
+                            num_partitions=kafka.num_partitions)
     # the App's ingestion endpoints work with any backend exposing
     # upsert_transactions (device index or external Qdrant); snapshot
     # persistence additionally needs a local .index (guarded there)
     app_retriever = retriever if hasattr(retriever, "upsert_transactions") else None
     app = App(cfg, agent=agent, store=store, kafka=kafka, scheduler=scheduler,
-              retriever=app_retriever)
+              retriever=app_retriever, fleet=fleet)
     if app._prefix_cache_enabled and tokenizer is not None:
-        app._registered_heads = register_prompt_prefixes(agent, scheduler, tokenizer)
+        if fleet is not None:
+            for rep in fleet.replicas:
+                rep.registered_heads = register_prompt_prefixes(
+                    rep.agent, rep.scheduler, tokenizer
+                )
+        else:
+            app._registered_heads = register_prompt_prefixes(agent, scheduler, tokenizer)
     return app

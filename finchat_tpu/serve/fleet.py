@@ -1,0 +1,435 @@
+"""Engine fleet: N replicas under one serving plane (ISSUE 6; ROBUSTNESS.md).
+
+After PRs 1-5 everything was one scheduler driving one engine: the breaker
+could rebuild a wedged engine, but the whole service shed while it rebuilt,
+and a persistently dead engine (breaker give-up) took every conversation
+down with it. This module is the millions-of-users step (ROADMAP item 2):
+
+- **Replicas**: ``fleet.replicas`` engine replicas, each its own scheduler,
+  KV page pool, and session cache, sharing one immutable weights tree (the
+  params leaves are read-only jax arrays — N replicas cost N KV pools, not
+  N models). Each replica's scheduler observes through a
+  ``METRICS.labeled(replica=...)`` view, so every existing metric family
+  separates per replica.
+- **Conversation-affinity router**: a conversation routes to the replica
+  that rendezvous-hashes highest for its KAFKA PARTITION
+  (``io/kafka.py partition_for_key`` — the exact hash the broker uses for
+  key→partition placement), so session-cache entries and prefix heads stay
+  local, same-partition conversations land on the same replica, and
+  routing agrees with partition assignment by construction. (CRC32 is
+  librdkafka's ``consistent`` partitioner and the memory broker's; Java
+  producers default to murmur2 — see the ``partition_for_key`` caveat.
+  Misalignment only costs affinity, never correctness.) Rendezvous
+  (highest-random-weight) hashing makes membership changes minimal: a
+  replica leaving moves ONLY its own partitions (spread over the
+  survivors); rejoining moves exactly those back.
+- **Drain-on-trip**: a replica's breaker trip no longer sheds — its live
+  streams are recompute-preempted to host (prompt + generated tokens on
+  the handle, device-free) and offered to the drain sink, which routes
+  each to a sibling and hands off the conversation's session-cache host
+  bytes (device-independent by construction). The handle's event queue
+  travels with it, so the client's stream continues byte-identical from
+  the sibling; the tripped replica rebuilds in the background.
+- **Give-up → OUT → supervised respawn**: a breaker give-up marks the
+  replica OUT (the router drops it; its partitions reassign), drains
+  whatever is still live, and the supervisor respawns it in the background
+  (``scheduler.revive`` — rebuild device state from a clean slate,
+  re-register prompt heads) with exponential backoff while the rest of the
+  fleet absorbs the load. On rejoin its partitions route back.
+- **Cross-replica session migration**: session-cache entries are host-RAM
+  byte snapshots keyed by conversation — exportable without the device.
+  Handoffs move them at drain time; ``replica_for`` additionally migrates
+  lazily at route time, so a conversation whose bytes ended up on a
+  sibling (drain, or a respawned replica re-adopting its partitions) gets
+  its resumed-prefill profile back on the very next turn. Entries whose KV
+  rode a shared-prefix head re-link against the importer's OWN live
+  registration of the same head (every replica registers the same heads).
+
+Single-process by design: the replicas share one asyncio loop (handles and
+their event queues cross schedulers freely), matching how one host serves
+one TPU pod slice with per-chip/per-slice engines. Multi-HOST fleets stack
+this under the existing consumer-group layer (__main__.py), where the same
+partition alignment applies across processes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from finchat_tpu.engine.session_cache import (
+    SESSION_KEY_ROLES,
+    conversation_of,
+    session_key,
+)
+from finchat_tpu.io.kafka import DEFAULT_NUM_PARTITIONS, partition_for_key
+from finchat_tpu.utils.config import FleetConfig
+from finchat_tpu.utils.logging import get_logger
+from finchat_tpu.utils.metrics import METRICS
+
+logger = get_logger(__name__)
+
+# replica lifecycle: LIVE (routed to), OUT (breaker gave up; router skips
+# it), RESPAWNING (supervisor is reviving it; still skipped)
+LIVE = "live"
+OUT = "out"
+RESPAWNING = "respawning"
+
+
+def rendezvous_hash(key: str, candidates: list[str]) -> str | None:
+    """Highest-random-weight (rendezvous) choice of ``candidates`` for
+    ``key``: every (key, candidate) pair gets a stable pseudo-random
+    weight and the max wins. Removing a candidate reassigns ONLY the keys
+    it owned (each to its runner-up); adding one back restores exactly
+    the old mapping — the ≤ ~1/N reshuffle property the fleet router
+    needs across replica loss/join (tests/test_fleet.py pins it)."""
+    if not candidates:
+        return None
+    best, best_w = None, -1
+    for cand in candidates:
+        w = int.from_bytes(
+            hashlib.blake2b(
+                f"{key}\x00{cand}".encode(), digest_size=8
+            ).digest(),
+            "big",
+        )
+        if w > best_w or (w == best_w and (best is None or cand < best)):
+            best, best_w = cand, w
+    return best
+
+
+class DedupeRing:
+    """Bounded answered-``message_id`` ring, lifted from the per-replica
+    serving loop to the ROUTER level (ISSUE 6 satellite): with one ring
+    shared across the fleet, a replica crash plus Kafka redelivery of its
+    uncommitted messages to a sibling replica cannot double-answer a
+    conversation — the sibling consults the same ring the dead replica's
+    answers were recorded in. (Across PROCESSES the at-least-once trade
+    documented in serve/app.py still applies.)"""
+
+    def __init__(self, size: int = 1024):
+        self.size = size
+        self._ids: set = set()
+        self._ring: deque = deque()
+
+    def seen(self, message_id) -> bool:
+        """True when ``message_id`` was already recorded (answered or in
+        flight); records it otherwise."""
+        if message_id in self._ids:
+            return True
+        self._ids.add(message_id)
+        self._ring.append(message_id)
+        if len(self._ring) > self.size:
+            self._ids.discard(self._ring.popleft())
+        return False
+
+    def forget(self, message_id) -> None:
+        """Drop an id whose handling FAILED (never answered), so a
+        producer retry is reprocessed — including its ring slot, else a
+        stale duplicate would age out the re-added answered id early."""
+        self._ids.discard(message_id)
+        try:
+            self._ring.remove(message_id)
+        except ValueError:
+            pass
+
+
+@dataclass
+class EngineReplica:
+    """One engine replica: scheduler + generator (+ per-replica agent once
+    the serving layer binds one). ``registered_heads`` tracks which shared
+    prompt heads are live on THIS replica's scheduler — registration is
+    per device state, so every replica re-registers after its own
+    rebuilds."""
+
+    replica_id: str
+    scheduler: Any
+    generator: Any = None
+    agent: Any = None
+    state: str = LIVE
+    registered_heads: set = field(default_factory=set)
+
+
+class EngineFleet:
+    """The router + drain plumbing + supervisor over a replica list."""
+
+    def __init__(self, replicas: list[EngineReplica], cfg: FleetConfig | None = None,
+                 num_partitions: int = DEFAULT_NUM_PARTITIONS, metrics=None):
+        assert replicas, "a fleet needs at least one replica"
+        self.replicas = list(replicas)
+        self.cfg = cfg or FleetConfig()
+        self.num_partitions = num_partitions
+        if len(self.replicas) > num_partitions:
+            # the Kafka partition is THE routing unit: at most one replica
+            # per partition can ever be selected, so extras idle (full KV
+            # pool, scheduler loop, zero traffic) — raise kafka.num_partitions
+            logger.warning(
+                "fleet: %d replicas but only %d Kafka partitions — routing "
+                "can address at most one replica per partition, the rest "
+                "will receive NO traffic; raise kafka.num_partitions",
+                len(self.replicas), num_partitions,
+            )
+        self.metrics = metrics if metrics is not None else METRICS
+        # router-level answered-message dedupe (see DedupeRing)
+        self.dedupe = DedupeRing()
+        self._by_id = {r.replica_id: r for r in self.replicas}
+        self._running = False
+        self._supervisor_task: asyncio.Task | None = None
+        # strong refs to in-flight _respawn tasks: an unreferenced task may
+        # be GC'd mid-flight (replica stuck RESPAWNING forever), and stop()
+        # must cancel them so a revive can't run against a stopped scheduler
+        self._respawn_tasks: set[asyncio.Task] = set()
+        # serving-layer hooks run after a replica respawns (e.g. the app
+        # re-registers its shared prompt heads on the fresh device state);
+        # sync or async callables taking the replica
+        self.on_respawn: list[Callable[[EngineReplica], Any]] = []
+        for rep in self.replicas:
+            self._wire(rep)
+        self._publish_live_gauge()
+
+    # --- wiring ---------------------------------------------------------
+    def _wire(self, rep: EngineReplica) -> None:
+        sched = rep.scheduler
+        if self.cfg.drain_on_trip and len(self.replicas) > 1:
+            sched.drain_sink = self._make_drain_sink(rep)
+        sched.on_give_up.append(lambda rep=rep: self._mark_out(rep))
+
+    def _publish_live_gauge(self) -> None:
+        self.metrics.set_gauge(
+            "finchat_fleet_replicas_live",
+            sum(1 for r in self.replicas if r.state == LIVE),
+        )
+
+    def _mark_out(self, rep: EngineReplica) -> None:
+        if rep.state == LIVE:
+            logger.error("fleet: replica %s is OUT (breaker give-up); "
+                         "reassigning its partitions", rep.replica_id)
+            rep.state = OUT
+            self._publish_live_gauge()
+
+    # --- routing --------------------------------------------------------
+    def live_replicas(self) -> list[EngineReplica]:
+        return [r for r in self.replicas if r.state == LIVE]
+
+    def partition_for(self, conversation_id: str) -> int:
+        return partition_for_key(conversation_id, self.num_partitions)
+
+    def replica_for_partition(self, partition: int,
+                              exclude: EngineReplica | None = None) -> EngineReplica | None:
+        """The live replica owning a Kafka partition — THE routing unit,
+        so every conversation of one partition routes together and the
+        assignment is expressible as a partition→replica map."""
+        ids = [r.replica_id for r in self.live_replicas() if r is not exclude]
+        rid = rendezvous_hash(str(partition), ids)
+        return self._by_id[rid] if rid is not None else None
+
+    def replica_for(self, conversation_id: str,
+                    exclude: EngineReplica | None = None) -> EngineReplica | None:
+        """Route a conversation: partition affinity → live replica, with
+        lazy cross-replica session migration — if another replica still
+        holds this conversation's session-cache bytes (it drained here
+        earlier, or this replica just respawned and took its partitions
+        back), the entry moves to the routed replica first, so admission
+        resumes from it instead of cold-prefilling."""
+        target = self.replica_for_partition(
+            self.partition_for(conversation_id), exclude=exclude
+        )
+        if target is None:
+            return None
+        if len(self.replicas) > 1:
+            if any(r is not target and r.state != LIVE for r in self.replicas):
+                # affinity owner may be out: count messages routed away
+                # from the all-live assignment while a sibling is down
+                all_ids = [r.replica_id for r in self.replicas]
+                home = rendezvous_hash(str(self.partition_for(conversation_id)), all_ids)
+                if home is not None and home != target.replica_id:
+                    self.metrics.inc("finchat_fleet_reroutes_total")
+            self._migrate_session(conversation_id, target)
+        return target
+
+    def agent_for(self, conversation_id: str):
+        """The routed replica's agent (serving-layer entry point). Raises
+        when no replica is live — the caller surfaces a retryable error."""
+        rep = self.replica_for(conversation_id)
+        if rep is None or rep.agent is None:
+            raise RuntimeError("no live engine replica")
+        return rep.agent
+
+    # --- session migration ----------------------------------------------
+    def _migrate_session(self, conversation_id: str, target: EngineReplica) -> None:
+        """Move a conversation's session-cache bytes to its routed replica
+        if a sibling holds (strictly deeper) ones — host-array reference
+        moves, no KV copy. The agent keys one entry PER LLM ROLE
+        (``conv#tool`` / ``conv#resp``, engine/session_cache.py), so every
+        role key is migrated alongside the bare id (direct scheduler
+        submissions). Best-effort: a refused import (no matching shared
+        head on the target) just means a cold resume."""
+        if getattr(target.scheduler, "session_cache", None) is None:
+            return
+        self._migrate_key(conversation_id, target)
+        for role in SESSION_KEY_ROLES:
+            self._migrate_key(session_key(conversation_id, role), target)
+
+    def _migrate_key(self, key: str, target: EngineReplica) -> None:
+        have = target.scheduler.session_cache.get(key)
+        have_n = have.n_tokens if have is not None else 0
+        for rep in self.replicas:
+            if rep is target:
+                continue
+            s_cache = getattr(rep.scheduler, "session_cache", None)
+            if s_cache is None:
+                continue
+            entry = s_cache.get(key)
+            if entry is None or entry.n_tokens <= have_n:
+                continue
+            payload = rep.scheduler.export_session(key)
+            if payload is None:
+                continue
+            try:
+                imported = target.scheduler.import_session_entry(payload)
+            except Exception as e:
+                logger.error("session migration %s→%s failed for %s: %s",
+                             rep.replica_id, target.replica_id, key, e)
+                continue
+            # the source copy goes either way: a stale twin left behind
+            # could serve diverged KV if routing ever flips back
+            s_cache.discard(key)
+            if imported:
+                self.metrics.inc("finchat_fleet_session_migrations_total")
+                logger.info("fleet: migrated session %s %s→%s (%d tokens)",
+                            key, rep.replica_id, target.replica_id,
+                            payload["token_ids"].shape[0])
+            return
+
+    # --- drain ----------------------------------------------------------
+    def _make_drain_sink(self, source: EngineReplica):
+        """The tripped/given-up replica's breaker calls this with each
+        preempted handle + its conversation's exported session bytes; a
+        sibling adopts both and the stream continues there."""
+
+        def sink(handle, session_payload) -> bool:
+            # route by the CONVERSATION, not the per-role cache key the
+            # handle carries — the adopter must be the replica the
+            # conversation's next turns route to, or the handed-off
+            # session bytes strand on a non-affinity sibling (and a
+            # conversation's #tool/#resp streams could split)
+            key = conversation_of(handle.conversation_id or handle.seq_id)
+            target = self.replica_for_partition(
+                self.partition_for(key), exclude=source
+            )
+            if target is None:
+                # not counted here: on a plain trip a refused handle stays
+                # pending and replays locally after the rebuild (no stream
+                # fails), and at give-up the scheduler's pending-fail loop
+                # counts every stream the drain couldn't save exactly once
+                return False
+            if not target.scheduler.adopt(handle):
+                # the adopter is at its backpressure bound and the handle
+                # was never admitted on the source — plain queued load.
+                # Refused like a fresh submit would be: on a trip it stays
+                # pending and replays locally after the rebuild; at
+                # give-up the pending-fail loop sheds it with the
+                # retryable replica_out error. adopt runs BEFORE the
+                # session import so a refusal leaves no twin of the
+                # conversation's bytes on the non-serving sibling.
+                return False
+            if session_payload is not None:
+                try:
+                    if target.scheduler.import_session_entry(session_payload):
+                        self.metrics.inc("finchat_fleet_session_handoffs_total")
+                except Exception as e:
+                    logger.error("session handoff to %s failed for %s: %s",
+                                 target.replica_id, key, e)
+            self.metrics.inc("finchat_fleet_drained_streams_total")
+            logger.info("fleet: drained %s (%s) %s→%s", handle.seq_id, key,
+                        source.replica_id, target.replica_id)
+            return True
+
+        return sink
+
+    # --- lifecycle ------------------------------------------------------
+    async def start(self) -> None:
+        for rep in self.replicas:
+            await rep.scheduler.start()
+        self._running = True
+        if self.cfg.respawn and len(self.replicas) > 1:
+            self._supervisor_task = asyncio.create_task(self._supervise())
+
+    async def stop(self) -> None:
+        self._running = False
+        for task in (*self._respawn_tasks,
+                     *([self._supervisor_task] if self._supervisor_task else ())):
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+        self._respawn_tasks.clear()
+        self._supervisor_task = None
+        for rep in self.replicas:
+            await rep.scheduler.stop()
+
+    async def _supervise(self) -> None:
+        """Watch for OUT replicas and respawn them while the fleet keeps
+        serving: revive the device state from a clean slate, run the
+        serving layer's on_respawn hooks (prompt-head re-registration),
+        then mark LIVE — the router folds its partitions back in."""
+        while self._running:
+            for rep in self.replicas:
+                if rep.state == OUT:
+                    rep.state = RESPAWNING
+                    task = asyncio.get_running_loop().create_task(
+                        self._respawn(rep)
+                    )
+                    self._respawn_tasks.add(task)
+                    task.add_done_callback(self._respawn_tasks.discard)
+            await asyncio.sleep(self.cfg.supervisor_interval_seconds)
+
+    async def _respawn(self, rep: EngineReplica) -> None:
+        delay = max(0.05, self.cfg.respawn_backoff_seconds)
+        while self._running:
+            try:
+                # revive_async threads the device rebuild — seconds of KV
+                # pool reallocation at real sizes — so the siblings' loops
+                # (and the streams the drain just saved) keep serving
+                ok = await rep.scheduler.revive_async()
+            except Exception as e:
+                logger.error("respawn of %s raised: %s", rep.replica_id, e)
+                ok = False
+            if ok:
+                rep.registered_heads = set()
+                for cb in list(self.on_respawn):
+                    try:
+                        result = cb(rep)
+                        if asyncio.iscoroutine(result):
+                            await result
+                    except Exception as e:
+                        logger.error("on_respawn hook failed for %s: %s",
+                                     rep.replica_id, e)
+                if getattr(rep.scheduler, "gave_up", False):
+                    # the respawn itself re-wedged the engine: the
+                    # on_respawn prompt-head re-registration drives real
+                    # prefill rounds, and a flaky device can trip the
+                    # breaker back to give-up while state is RESPAWNING —
+                    # which _mark_out (LIVE-guarded) ignores. Marking LIVE
+                    # here would route traffic to a known-wedged engine;
+                    # stay RESPAWNING and retry with backoff instead.
+                    logger.error(
+                        "fleet: replica %s re-wedged during respawn "
+                        "(give-up while RESPAWNING); retrying",
+                        rep.replica_id,
+                    )
+                    ok = False
+            if ok:
+                rep.state = LIVE
+                self._publish_live_gauge()
+                self.metrics.inc("finchat_fleet_respawns_total")
+                logger.info("fleet: replica %s respawned and LIVE",
+                            rep.replica_id)
+                return
+            await asyncio.sleep(delay)
+            delay = min(delay * 2, 10.0)
+        rep.state = OUT  # shutting down mid-respawn: leave it marked out

@@ -72,6 +72,15 @@ class KafkaConfig:
     auto_offset_reset: str = "latest"
     # "memory" = in-process broker (tests/dev); "confluent" = librdkafka.
     backend: str = "memory"
+    # partitions per topic. The process-wide memory broker is created with
+    # this count by the FIRST KafkaClient (an explicitly shared broker
+    # wins; a count mismatch warns and the broker's count is used for
+    # routing); on the confluent backend it must MATCH how the real topics
+    # were created — the fleet router hashes conversation keys mod this
+    # count (io/kafka.py partition_for_key), so a mismatch silently breaks
+    # the routing ≡ partition-assignment alignment (serve/fleet.py). Also
+    # FINCHAT_KAFKA_NUM_PARTITIONS.
+    num_partitions: int = 4
     # at-least-once delivery (default off = reference at-most-once parity):
     # disable poll-time auto-commit and commit offsets only AFTER the
     # watchdog-wrapped handler completes, so a worker crash mid-message
@@ -325,6 +334,35 @@ class EmbedConfig:
 
 
 @dataclass
+class FleetConfig:
+    """Engine replica fleet (serve/fleet.py — ISSUE 6; ROBUSTNESS.md).
+
+    ``replicas`` > 1 stands up N engine replicas under one serving plane —
+    each with its own scheduler, KV page pool, and session cache — behind a
+    router that rendezvous-hashes the conversation's Kafka partition
+    (io/kafka.py partition_for_key, the SAME hash the broker uses for
+    key→partition placement) to a live replica, so a conversation's
+    session-cache entries and prefix heads stay local and routing agrees
+    with partition assignment by construction.
+    """
+
+    replicas: int = 1
+    # breaker trips DRAIN the replica's live conversations to siblings
+    # (preempt-to-host + session-cache handoff; streams continue
+    # byte-identical on the adopter) instead of riding out the rebuild on
+    # the tripped replica; a give-up replica is marked OUT, its routing
+    # share reassigned, and the supervisor respawns it. False = every
+    # replica recovers alone, exactly the PR 5 single-engine behavior.
+    drain_on_trip: bool = True
+    # supervisor: respawn (rebuild device state, re-register prompt heads)
+    # a given-up replica in the background while the rest of the fleet
+    # absorbs its load; False leaves it OUT until process restart
+    respawn: bool = True
+    respawn_backoff_seconds: float = 0.5
+    supervisor_interval_seconds: float = 0.2
+
+
+@dataclass
 class ServeConfig:
     host: str = "0.0.0.0"
     port: int = 8000
@@ -339,6 +377,7 @@ class AppConfig:
     mesh: MeshConfig = field(default_factory=MeshConfig)
     engine: EngineConfig = field(default_factory=EngineConfig)
     embed: EmbedConfig = field(default_factory=EmbedConfig)
+    fleet: FleetConfig = field(default_factory=FleetConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
 
     def to_dict(self) -> dict[str, Any]:
@@ -385,6 +424,9 @@ def load_config(
     cfg.kafka.backend = _env("FINCHAT_KAFKA_BACKEND", cfg.kafka.backend)
     cfg.kafka.commit_after_process = _env_bool(
         "FINCHAT_KAFKA_COMMIT_AFTER_PROCESS", cfg.kafka.commit_after_process
+    )
+    cfg.kafka.num_partitions = _env_int(
+        "FINCHAT_KAFKA_NUM_PARTITIONS", cfg.kafka.num_partitions
     )
     cfg.store.backend = _env("FINCHAT_STORE_BACKEND", cfg.store.backend)
     cfg.vector.persist_path = _env("FINCHAT_VECTOR_PERSIST", cfg.vector.persist_path)
@@ -437,6 +479,11 @@ def load_config(
     cfg.engine.max_queue_depth = _env_int(
         "FINCHAT_MAX_QUEUE_DEPTH", cfg.engine.max_queue_depth
     )
+    cfg.fleet.replicas = _env_int("FINCHAT_FLEET_REPLICAS", cfg.fleet.replicas)
+    cfg.fleet.drain_on_trip = _env_bool(
+        "FINCHAT_FLEET_DRAIN_ON_TRIP", cfg.fleet.drain_on_trip
+    )
+    cfg.fleet.respawn = _env_bool("FINCHAT_FLEET_RESPAWN", cfg.fleet.respawn)
     cfg.serve.port = _env_int("FINCHAT_PORT", cfg.serve.port)
 
     # --- optional JSON config file ---

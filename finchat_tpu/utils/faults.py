@@ -112,3 +112,16 @@ def for_seq(seq_id: str, exc: Exception) -> Handler:
             raise exc
 
     return handler
+
+
+def for_replica(replica_id: str, inner: Handler) -> Handler:
+    """Scope ``inner`` to one fleet replica (ctx['replica'] — each
+    replica's scheduler stamps its id on its dispatch sites), so a chaos
+    drill can wedge ONE engine while its siblings stay healthy
+    (bench.py --fleet-sweep, tests/test_fleet.py)."""
+
+    def handler(**ctx: Any) -> None:
+        if ctx.get("replica") == replica_id:
+            inner(**ctx)
+
+    return handler

@@ -52,6 +52,26 @@ and ``finchat_breaker_recovery_seconds`` (trip → first successful round).
 ``finchat_kafka_commits_total`` / ``finchat_kafka_dedupe_skips_total``
 instrument the at-least-once option (kafka.commit_after_process).
 
+Fleet family (serve/fleet.py — ISSUE 6): with ``fleet.replicas`` > 1 every
+per-engine family above (inter-token, dispatches, breaker_state, session
+cache, preemptions, ...) is emitted PER REPLICA via a ``replica`` label —
+each replica's scheduler and session cache observe through a
+``MetricsRegistry.labeled(replica="N")`` view, so one Prometheus scrape
+separates a draining replica's recovery from its siblings' steady state.
+Fleet-level series: ``finchat_fleet_replicas_live`` (gauge — LIVE replicas
+the router spreads over), ``finchat_fleet_drained_streams_total``
+(in-flight streams handed to a sibling by a breaker drain),
+``finchat_fleet_drain_failures_total`` (streams the give-up drain could
+not place on a sibling — each failed with a retryable ``replica_out``
+error; counted once per stream), ``finchat_fleet_session_migrations_total`` /
+``finchat_fleet_session_handoffs_total`` (cross-replica session-cache
+entry moves: lazy route-time migration / drain-time handoff),
+``finchat_fleet_session_import_refused_total`` (imported entry's shared
+head had no live twin on the adopter — entry dropped, cold resume),
+``finchat_fleet_respawns_total`` (supervisor revivals of a given-up
+replica), and ``finchat_fleet_reroutes_total`` (messages routed away
+from their affinity replica while it was out).
+
 Retrieval-plane family (embed/batcher.py microbatcher, embed/index.py
 batched search, agent/scheduler overlap):
 ``finchat_embed_batch_occupancy`` (gauge — texts in the last coalesced
@@ -141,13 +161,15 @@ class MetricsRegistry:
         self._gauges: dict[str, float] = {}
         self._histograms: dict[str, _Histogram] = {}
 
-    def inc(self, name: str, value: float = 1.0) -> None:
+    def inc(self, name: str, value: float = 1.0,
+            labels: dict[str, str] | None = None) -> None:
         with self._lock:
-            self._counters[name] += value
+            self._counters[_labeled_key(name, labels)] += value
 
-    def set_gauge(self, name: str, value: float) -> None:
+    def set_gauge(self, name: str, value: float,
+                  labels: dict[str, str] | None = None) -> None:
         with self._lock:
-            self._gauges[name] = value
+            self._gauges[_labeled_key(name, labels)] = value
 
     def observe(self, name: str, value: float,
                 labels: dict[str, str] | None = None) -> None:
@@ -157,11 +179,19 @@ class MetricsRegistry:
                 self._histograms[key] = _Histogram()
             self._histograms[key].observe(value)
 
-    def get(self, name: str) -> float:
+    def get(self, name: str, labels: dict[str, str] | None = None) -> float:
+        key = _labeled_key(name, labels)
         with self._lock:
-            if name in self._counters:
-                return self._counters[name]
-            return self._gauges.get(name, 0.0)
+            if key in self._counters:
+                return self._counters[key]
+            return self._gauges.get(key, 0.0)
+
+    def labeled(self, **labels: str) -> "LabeledMetrics":
+        """A view of this registry that stamps ``labels`` onto every
+        series it touches — how a fleet replica's scheduler and session
+        cache emit the same metric families under a ``replica`` label
+        without threading label dicts through every call site."""
+        return LabeledMetrics(self, labels)
 
     def quantile(self, name: str, q: float,
                  labels: dict[str, str] | None = None) -> float:
@@ -184,12 +214,18 @@ class MetricsRegistry:
     def render_prometheus(self) -> str:
         lines: list[str] = []
         with self._lock:
-            for name, value in sorted(self._counters.items()):
-                lines.append(f"# TYPE {name} counter")
-                lines.append(f"{name} {value}")
-            for name, value in sorted(self._gauges.items()):
-                lines.append(f"# TYPE {name} gauge")
-                lines.append(f"{name} {value}")
+            # label variants of one counter/gauge group under a single
+            # TYPE line keyed by the BASE name (Prometheus text format
+            # wants a metric's series consecutive) — same discipline as
+            # the histogram rendering below
+            for store, kind in ((self._counters, "counter"), (self._gauges, "gauge")):
+                seen: set[str] = set()
+                for key in sorted(store, key=_split_key):
+                    base, _lbl = _split_key(key)
+                    if base not in seen:
+                        seen.add(base)
+                        lines.append(f"# TYPE {base} {kind}")
+                    lines.append(f"{key} {store[key]}")
             # group label variants of one histogram under a single TYPE
             # line (Prometheus text format wants a metric's series
             # consecutive); labeled bucket lines merge the series labels
@@ -217,6 +253,44 @@ class MetricsRegistry:
                 lines.append(f"{base}_sum{series()} {h.total}")
                 lines.append(f"{base}_count{series()} {h.n}")
         return "\n".join(lines) + "\n"
+
+
+class LabeledMetrics:
+    """Registry view with a fixed label set merged into every call.
+
+    Drop-in for ``METRICS`` at the call sites the scheduler and session
+    cache use (``inc`` / ``set_gauge`` / ``observe`` / ``get`` /
+    ``quantile`` and as a ``Timer`` target): a fleet replica constructs
+    its scheduler with ``METRICS.labeled(replica="2")`` and every
+    existing metric family comes out as ``name{replica="2"}`` series.
+    Call-site labels merge OVER the fixed ones (call-site wins on a key
+    collision, which never happens for ``replica``)."""
+
+    def __init__(self, registry: MetricsRegistry, labels: dict[str, str]):
+        self._registry = registry
+        self.labels = {k: str(v) for k, v in labels.items()}
+
+    def _merge(self, labels: dict[str, str] | None) -> dict[str, str]:
+        return {**self.labels, **labels} if labels else self.labels
+
+    def inc(self, name: str, value: float = 1.0,
+            labels: dict[str, str] | None = None) -> None:
+        self._registry.inc(name, value, labels=self._merge(labels))
+
+    def set_gauge(self, name: str, value: float,
+                  labels: dict[str, str] | None = None) -> None:
+        self._registry.set_gauge(name, value, labels=self._merge(labels))
+
+    def observe(self, name: str, value: float,
+                labels: dict[str, str] | None = None) -> None:
+        self._registry.observe(name, value, labels=self._merge(labels))
+
+    def get(self, name: str, labels: dict[str, str] | None = None) -> float:
+        return self._registry.get(name, labels=self._merge(labels))
+
+    def quantile(self, name: str, q: float,
+                 labels: dict[str, str] | None = None) -> float:
+        return self._registry.quantile(name, q, labels=self._merge(labels))
 
 
 # Process-global registry (one worker process = one registry, matching the

