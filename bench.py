@@ -148,6 +148,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="tiny --fleet-sweep variant for CI: same gates, "
                         "same drill (the drill IS the smoke — it is "
                         "CPU-sized already)")
+    p.add_argument("--durability-sweep", action="store_true",
+                   help="crash-restart + graceful-drain drill (ISSUE 7): a "
+                        "real App over the memory broker with the answered-"
+                        "message journal and session disk tier on; kill it "
+                        "mid-stream, restart, redeliver — zero double "
+                        "answers, byte-identical final answers, next turn "
+                        "resumed from disk; then SIGTERM-drain with zero "
+                        "slot/page leaks")
+    p.add_argument("--durability-smoke", action="store_true",
+                   help="CI variant of --durability-sweep (same drill, "
+                        "smoke-sized)")
     p.add_argument("--fleet-replicas", type=int, default=4,
                    help="replica count for --fleet-sweep")
     p.add_argument("--tpu-timeout", type=float, default=180.0,
@@ -201,7 +212,9 @@ def run_worker(args: argparse.Namespace) -> int:
     faulthandler.dump_traceback_later(max(60.0, args.measure_budget - 10.0), exit=True)
 
     work = resolve_workload(args, "tpu" if platform == "tpu" else "cpu")
-    if args.fleet_sweep or args.fleet_smoke:
+    if args.durability_sweep or args.durability_smoke:
+        result = measure_durability_sweep(smoke=args.durability_smoke)
+    elif args.fleet_sweep or args.fleet_smoke:
         result = measure_fleet_sweep(
             smoke=args.fleet_smoke, replicas=args.fleet_replicas
         )
@@ -1644,6 +1657,328 @@ def measure_fleet_sweep(smoke: bool = False, replicas: int = 4) -> dict:
     }
 
 
+def measure_durability_sweep(smoke: bool = False) -> dict:
+    """Crash-restart + graceful-drain drill (ISSUE 7), CPU-runnable through
+    a REAL App over the memory Kafka broker on the tiny fp32 config (fp32
+    pins greedy byte-identity across the restart — both processes share one
+    params tree).
+
+    Phase 1 (crash): with the answered-message journal, committed-offset
+    persistence, and the session disk tier on — answer turn 1 of
+    conversation A (journaled + committed), answer conversation B but
+    CRASH before its offset commits (journaled, uncommitted — the exact
+    fsync-before-commit window), and crash mid-stream on turn 2 of A.
+    Restart over the same broker:
+
+    - B redelivers and is SKIPPED (journal replay seeded the dedupe ring):
+      zero double answers;
+    - A's turn 2 redelivers and reprocesses to completion, and every
+      final stored answer is byte-identical to an uninterrupted control
+      run;
+    - turn 2's admission RESUMES from the disk tier (restores >= 1,
+      restored tokens > 0) — the restarted process is warm, not cold.
+
+    Phase 2 (drain): SIGTERM-equivalent ``drain_and_stop`` with a message
+    mid-stream — the stream COMPLETES within the deadline, the scheduler
+    exits with zero slot/page leaks, and a post-restart turn resumes from
+    the spilled session bytes.
+    """
+    import asyncio
+    import dataclasses
+    import os as _os
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from finchat_tpu.engine.engine import InferenceEngine
+    from finchat_tpu.engine.generator import EngineGenerator, StubGenerator
+    from finchat_tpu.engine.scheduler import ContinuousBatchingScheduler
+    from finchat_tpu.io.kafka import InMemoryBroker, KafkaClient
+    from finchat_tpu.io.store import InMemoryStore
+    from finchat_tpu.models.llama import PRESETS, init_params
+    from finchat_tpu.models.tokenizer import ByteTokenizer
+    from finchat_tpu.serve.app import build_app
+    from finchat_tpu.utils.config import (
+        AI_RESPONSE_TOPIC,
+        USER_MESSAGE_TOPIC,
+        EngineConfig,
+        load_config,
+    )
+    from finchat_tpu.utils.metrics import METRICS
+
+    config = dataclasses.replace(PRESETS["tiny"], dtype=jnp.float32)
+    params = init_params(config, jax.random.key(0))
+    tok = ByteTokenizer()
+    root = tempfile.mkdtemp(prefix="finchat-durability-")
+    n_new = 6 if smoke else 10
+
+    def make_cfg(tag: str):
+        cfg = load_config(overrides={"model.preset": "stub"})
+        cfg.engine.temperature = 0.0
+        cfg.engine.max_new_tokens = n_new
+        cfg.kafka.commit_after_process = True
+        cfg.journal.path = _os.path.join(root, tag, "journal")
+        cfg.kafka.offsets_dir = cfg.journal.path
+        cfg.engine.session_cache_disk_path = _os.path.join(root, tag, "disk")
+        cfg.shutdown.deadline_seconds = 60.0
+        return cfg
+
+    def make_sched(cfg):
+        ecfg = EngineConfig(
+            max_seqs=4, page_size=8, num_pages=128, max_seq_len=256,
+            prefill_chunk=16, session_cache=True, session_cache_bytes=32 << 20,
+            session_cache_disk_path=cfg.engine.session_cache_disk_path,
+            session_cache_disk_bytes=64 << 20,
+        )
+        return ContinuousBatchingScheduler(
+            InferenceEngine(config, params, ecfg), eos_id=-1
+        )
+
+    class NullRetriever:
+        async def __call__(self, args):
+            return []
+
+    def make_store():
+        store = InMemoryStore()
+        for conv in ("convA", "convB"):
+            store.upsert_context(conv, {
+                "user_id": "u1", "name": "Alex", "income": 5000,
+                "savings_goal": 800,
+            })
+            store.add_user_message(conv, "hello", "u1")
+        return store
+
+    def make_app(cfg, broker, store, sched):
+        app = build_app(
+            cfg, store=store, kafka=KafkaClient(cfg.kafka, broker=broker),
+            tool_generator=StubGenerator(default="No tool call"),
+            response_generator=EngineGenerator(sched, tok),
+            retriever=NullRetriever(),
+        )
+        app.scheduler = sched  # drain/stop manage the injected engine
+        return app
+
+    def produce(broker, cfg, conv, mid, text):
+        KafkaClient(cfg.kafka, broker=broker).produce_message(
+            USER_MESSAGE_TOPIC, conv,
+            {"message": text, "conversation_id": conv, "user_id": "u1",
+             "message_id": mid},
+        )
+
+    def chunks(broker):
+        import json as _json
+
+        return [_json.loads(m.value().decode())
+                for m in broker.drain(AI_RESPONSE_TOPIC)]
+
+    def n_complete(broker, mid):
+        return sum(1 for c in chunks(broker)
+                   if c.get("type") == "complete" and c.get("message_id") == mid)
+
+    def n_chunks(broker, mid):
+        return sum(1 for c in chunks(broker)
+                   if c.get("type") == "response_chunk"
+                   and c.get("message_id") == mid)
+
+    async def wait_for(pred, timeout=240.0):
+        import time as _time
+
+        t0 = _time.perf_counter()
+        while not pred():
+            if _time.perf_counter() - t0 > timeout:
+                raise TimeoutError("durability drill: condition not reached")
+            await asyncio.sleep(0.01)
+
+    async def crash(app, sched):
+        """Process-kill emulation: no graceful drain, no commits, no
+        journal close — just tear the tasks down and leave the group (a
+        real crash ends in session-timeout eviction)."""
+        app._running = False
+        if app._consume_task:
+            app._consume_task.cancel()
+            try:
+                await app._consume_task
+            except asyncio.CancelledError:
+                pass
+        for t in list(app._inflight):
+            t.cancel()
+        if app._inflight:
+            await asyncio.gather(*app._inflight, return_exceptions=True)
+        await sched.stop()
+        # the write-behind spill queue drains in milliseconds while a real
+        # crash's restart takes seconds; flushing models that gap
+        # deterministically, so the restart's directory sweep can't race
+        # an in-flight record write from the dead scheduler's writer
+        if sched.session_cache is not None and sched.session_cache.disk is not None:
+            sched.session_cache.disk.flush()
+        app.kafka.close()
+
+    async def answered_texts(store):
+        return {conv: [m.message for m in await store.get_history(conv)
+                       if m.sender == "AIMessage"]
+                for conv in ("convA", "convB")}
+
+    async def control() -> dict:
+        cfg = make_cfg("control")
+        broker = InMemoryBroker(offsets_dir=cfg.kafka.offsets_dir)
+        store = make_store()
+        sched = make_sched(cfg)
+        app = make_app(cfg, broker, store, sched)
+        await app.start(serve_http=False)
+        try:
+            for mid, conv, text in (("mA1", "convA", "how am I doing?"),
+                                    ("mB", "convB", "what changed?"),
+                                    ("mA2", "convA", "and my savings?")):
+                produce(broker, cfg, conv, mid, text)
+                await wait_for(lambda mid=mid: n_complete(broker, mid) >= 1)
+        finally:
+            await app.stop()
+        return {"answers": await answered_texts(store)}
+
+    async def crash_restart() -> dict:
+        cfg = make_cfg("crash")
+        broker = InMemoryBroker(offsets_dir=cfg.kafka.offsets_dir)
+        store = make_store()
+        out: dict = {}
+        sched1 = make_sched(cfg)
+        app1 = make_app(cfg, broker, store, sched1)
+        await app1.start(serve_http=False)
+        # turn 1 of A: answered, journaled, COMMITTED (wait for the commit
+        # itself — the done-callback runs a beat after the complete chunk)
+        c0 = METRICS.get("finchat_kafka_commits_total")
+        j0 = METRICS.get("finchat_durability_journal_appends_total")
+        produce(broker, cfg, "convA", "mA1", "how am I doing?")
+        await wait_for(lambda: n_complete(broker, "mA1") >= 1
+                       and METRICS.get("finchat_kafka_commits_total") > c0)
+        # from here the process "dies before committing": B answers (and
+        # journals, fsync) but its offset commit is lost
+        app1.kafka.commit_offset = lambda *a, **k: None
+        produce(broker, cfg, "convB", "mB", "what changed?")
+        await wait_for(lambda: n_complete(broker, "mB") >= 1 and
+                       METRICS.get("finchat_durability_journal_appends_total")
+                       >= j0 + 2)
+        # turn 2 of A: crash MID-STREAM (some chunks out, no complete).
+        # Slow decode while this turn streams so the crash lands
+        # deterministically mid-stream — a 6-token turn can otherwise
+        # finish inside one poll interval of the chunk watcher
+        from finchat_tpu.utils import faults as _faults
+
+        import time as _time
+
+        _faults.arm("scheduler.decode", lambda **_: _time.sleep(0.02))
+        try:
+            produce(broker, cfg, "convA", "mA2", "and my savings?")
+            await wait_for(lambda: n_chunks(broker, "mA2") >= 1)
+            await crash(app1, sched1)
+        finally:
+            _faults.disarm("scheduler.decode")
+        assert n_complete(broker, "mA2") == 0, (
+            "drill setup: the crash was meant to land mid-stream"
+        )
+        out["completes_before_restart"] = {
+            mid: n_complete(broker, mid) for mid in ("mA1", "mB", "mA2")
+        }
+        # restart: same broker (group rewinds to the committed watermark),
+        # same journal + disk dirs — mB and mA2 redeliver
+        r0 = METRICS.get("finchat_durability_disk_restores_total")
+        rt0 = METRICS.get("finchat_session_cache_restored_tokens_total")
+        d0 = METRICS.get("finchat_kafka_dedupe_skips_total")
+        sched2 = make_sched(cfg)
+        app2 = make_app(cfg, broker, store, sched2)
+        await app2.start(serve_http=False)
+        try:
+            await wait_for(lambda: n_complete(broker, "mA2") >= 1)
+            # give the redelivered-mB dedupe skip a beat to be counted
+            await wait_for(lambda: METRICS.get("finchat_kafka_dedupe_skips_total") > d0)
+        finally:
+            await app2.stop()
+        out["completes"] = {mid: n_complete(broker, mid)
+                           for mid in ("mA1", "mB", "mA2")}
+        out["dedupe_skips"] = int(
+            METRICS.get("finchat_kafka_dedupe_skips_total") - d0)
+        out["disk_restores"] = int(
+            METRICS.get("finchat_durability_disk_restores_total") - r0)
+        out["restored_tokens"] = int(
+            METRICS.get("finchat_session_cache_restored_tokens_total") - rt0)
+        out["answers"] = await answered_texts(store)
+        return out
+
+    async def drain_drill() -> dict:
+        cfg = make_cfg("drain")
+        broker = InMemoryBroker(offsets_dir=cfg.kafka.offsets_dir)
+        store = make_store()
+        out: dict = {}
+        sched = make_sched(cfg)
+        app = make_app(cfg, broker, store, sched)
+        await app.start(serve_http=False)
+        produce(broker, cfg, "convA", "mD1", "how am I doing?")
+        await wait_for(lambda: n_chunks(broker, "mD1") >= 1)
+        # SIGTERM: the in-flight stream must COMPLETE within the deadline
+        await app.drain_and_stop()
+        out["drain_completed"] = n_complete(broker, "mD1") >= 1
+        out["zero_leaks"] = (
+            sched.allocator.used_count == 0
+            and len(sched.free_slots) == 4
+            and not sched.decoding and not sched.prefilling and not sched.pending
+        )
+        # restart after the graceful drain: the next turn resumes from the
+        # spilled session bytes
+        r0 = METRICS.get("finchat_durability_disk_restores_total")
+        sched2 = make_sched(cfg)
+        app2 = make_app(cfg, broker, store, sched2)
+        await app2.start(serve_http=False)
+        try:
+            produce(broker, cfg, "convA", "mD2", "and my savings?")
+            await wait_for(lambda: n_complete(broker, "mD2") >= 1)
+        finally:
+            await app2.stop()
+        out["restart_restores"] = int(
+            METRICS.get("finchat_durability_disk_restores_total") - r0)
+        return out
+
+    t0 = time.perf_counter()
+    clean = asyncio.run(control())
+    chaos = asyncio.run(crash_restart())
+    drain = asyncio.run(drain_drill())
+    wall = time.perf_counter() - t0
+
+    zero_double = all(n == 1 for n in chaos["completes"].values())
+    identical = chaos["answers"] == clean["answers"]
+    resumed = chaos["disk_restores"] >= 1 and chaos["restored_tokens"] > 0
+    print(f"[bench] durability crash: completes={chaos['completes']} "
+          f"dedupe_skips={chaos['dedupe_skips']} identical={identical} "
+          f"disk_restores={chaos['disk_restores']} "
+          f"restored_tokens={chaos['restored_tokens']}",
+          file=sys.stderr, flush=True)
+    print(f"[bench] durability drain: completed={drain['drain_completed']} "
+          f"zero_leaks={drain['zero_leaks']} "
+          f"restart_restores={drain['restart_restores']}",
+          file=sys.stderr, flush=True)
+
+    return {
+        "metric": "durability_sweep",
+        "unit": "crash/drain gates",
+        "smoke": smoke,
+        "model": "tiny (fp32 — identity contract, see measure_durability_sweep)",
+        # acceptance gates (tier1.yml --durability-smoke; ISSUE 7)
+        "zero_double_answers": zero_double,
+        "answered_before_restart": chaos["completes_before_restart"],
+        "completes_per_message": chaos["completes"],
+        "journal_dedupe_skips": chaos["dedupe_skips"],
+        "crash_outputs_identical": identical,
+        "crash_restart_resumed": resumed,
+        "disk_restores": chaos["disk_restores"],
+        "restored_tokens": chaos["restored_tokens"],
+        "drain_completed_inflight": drain["drain_completed"],
+        "drain_zero_leaks": drain["zero_leaks"],
+        "drained_restart_resumed": drain["restart_restores"] >= 1,
+        "wall_s": round(wall, 2),
+        "platform": jax.devices()[0].platform,
+        "device": str(jax.devices()[0]),
+    }
+
+
 # --------------------------------------------------------------------------
 # Orchestrator: jax-free; spawns workers, never hangs, always prints JSON.
 # --------------------------------------------------------------------------
@@ -1676,6 +2011,9 @@ def spawn_worker(args: argparse.Namespace, platform: str, timeout: float) -> dic
     if args.chaos_sweep or args.chaos_smoke:
         cmd += ["--chaos-rates", args.chaos_rates]
         cmd += ["--chaos-smoke"] if args.chaos_smoke else ["--chaos-sweep"]
+    if args.durability_sweep or args.durability_smoke:
+        cmd += (["--durability-smoke"] if args.durability_smoke
+                else ["--durability-sweep"])
     if args.fleet_sweep or args.fleet_smoke:
         cmd += ["--fleet-replicas", str(args.fleet_replicas)]
         cmd += ["--fleet-smoke"] if args.fleet_smoke else ["--fleet-sweep"]

@@ -55,6 +55,23 @@ def main() -> None:
                         "(serve/fleet.py): conversation-affinity routing, "
                         "breaker drains to siblings, supervised respawn; "
                         "1 = single engine — also FINCHAT_FLEET_REPLICAS")
+    p.add_argument("--journal-dir", default=None,
+                   help="durability directory (io/journal.py; ISSUE 7): "
+                        "answered message ids journal here (fsync before "
+                        "the Kafka commit) and replay into the dedupe ring "
+                        "at restart; the memory broker's committed offsets "
+                        "persist here too — also FINCHAT_JOURNAL_PATH")
+    p.add_argument("--session-disk", default=None,
+                   help="session-KV disk spill tier directory (engine/"
+                        "session_cache.py SessionDiskTier): entries write "
+                        "through to checksummed record files so a restarted "
+                        "process resumes conversations warm — also "
+                        "FINCHAT_SESSION_CACHE_DISK")
+    p.add_argument("--shutdown-deadline-seconds", type=float, default=None,
+                   help="graceful SIGTERM drain window: in-flight streams "
+                        "may finish for this long before stragglers are "
+                        "preempted to host with a retryable error — also "
+                        "FINCHAT_SHUTDOWN_DEADLINE_SECONDS")
     args = p.parse_args()
 
     overrides: dict = {}
@@ -70,6 +87,12 @@ def main() -> None:
         overrides["engine.request_deadline_seconds"] = args.request_deadline_seconds
     if args.fleet_replicas is not None:
         overrides["fleet.replicas"] = args.fleet_replicas
+    if args.journal_dir is not None:
+        overrides["journal.path"] = args.journal_dir
+    if args.session_disk is not None:
+        overrides["engine.session_cache_disk_path"] = args.session_disk
+    if args.shutdown_deadline_seconds is not None:
+        overrides["shutdown.deadline_seconds"] = args.shutdown_deadline_seconds
     cfg = load_config(args.config, overrides)
 
     from finchat_tpu.serve.app import build_app
@@ -96,8 +119,14 @@ def main() -> None:
             cfg.model.preset, not args.no_http, cfg.serve.port,
         )
         await stop.wait()
-        logger.info("shutting down")
-        await app.stop()
+        # graceful drain (ISSUE 7): stop admission, finish in-flight
+        # streams within shutdown.deadline_seconds, preempt stragglers to
+        # host with a retryable error, spill session bytes to the disk
+        # tier, journal + commit, exit with zero slot/page leaks — the
+        # restarted process resumes conversations warm
+        logger.info("shutting down (graceful drain, deadline %.0fs)",
+                    cfg.shutdown.deadline_seconds)
+        await app.drain_and_stop()
 
     asyncio.run(run())
 

@@ -379,11 +379,35 @@ class ContinuousBatchingScheduler:
         # references on shared-prefix pages are released.
         self.session_cache = None
         if cfg.session_cache and cfg.session_cache_bytes > 0:
-            from finchat_tpu.engine.session_cache import SessionKVCache
+            from finchat_tpu.engine.session_cache import (
+                SessionDiskTier,
+                SessionKVCache,
+            )
 
+            # durability plane (ISSUE 7): disk spill tier under the RAM
+            # LRU — entries write through to checksummed record files and
+            # a RAM miss at admission falls back to disk, so a restarted
+            # process resumes conversations warm. Fleet replicas get
+            # sibling subdirectories (replica ids are stable across
+            # restarts, and migration handles the cross-replica moves).
+            disk = None
+            disk_path = getattr(cfg, "session_cache_disk_path", "")
+            if disk_path:
+                if replica_id is not None:
+                    import os as _os
+
+                    disk_path = _os.path.join(disk_path, f"replica-{replica_id}")
+                try:
+                    disk = SessionDiskTier(
+                        disk_path, cfg.session_cache_disk_bytes,
+                        metrics=self.metrics,
+                    )
+                except Exception as e:  # durability is best-effort
+                    logger.error("session disk tier unavailable at %s: %s",
+                                 disk_path, e)
             self.session_cache = SessionKVCache(
                 cfg.session_cache_bytes, page_size=cfg.page_size,
-                on_drop=self._session_drop, metrics=self.metrics,
+                on_drop=self._session_drop, metrics=self.metrics, disk=disk,
             )
 
     # --- public API -----------------------------------------------------
@@ -843,6 +867,12 @@ class ContinuousBatchingScheduler:
                 self.session_cache is not None and handle.conversation_id and not ring
             )
             if session_eligible:
+                if self.session_cache.get(handle.conversation_id) is None:
+                    # RAM miss falls through to the disk tier (ISSUE 7):
+                    # the record re-enters through import_session_entry
+                    # (head re-link + refcount), then match() below applies
+                    # the usual token comparison and divergence truncation
+                    self._restore_session_from_disk(handle.conversation_id)
                 s_entry, s_matched = self.session_cache.match(
                     handle.conversation_id, handle.prompt_ids
                 )
@@ -1213,7 +1243,8 @@ class ContinuousBatchingScheduler:
             return None
         return self.session_cache.export_entry(conversation_id)
 
-    def import_session_entry(self, payload: dict | None) -> bool:
+    def import_session_entry(self, payload: dict | None, *,
+                             spill: bool = True) -> bool:
         """Adopt a sibling's exported session-cache entry (drain handoff /
         lazy route-time migration). The export carries no device pages —
         an entry whose KV rode a shared-prefix head re-links against THIS
@@ -1252,12 +1283,92 @@ class ContinuousBatchingScheduler:
             # _maybe_offload discipline
             entry_ref.refs += 1
         ok = self.session_cache.import_entry(
-            payload, prefix_entry=entry_ref, prefix_pages=pages
+            payload, prefix_entry=entry_ref, prefix_pages=pages, spill=spill
         )
         if not ok and entry_ref is not None:
             entry_ref.refs -= 1
             self._reap_prefixes()
         return ok
+
+    # --- durability plane (ISSUE 7; ROBUSTNESS.md §5) --------------------
+    def _restore_session_from_disk(self, conversation_id: str) -> bool:
+        """RAM-miss fall-through to the session disk tier: load the
+        conversation's record (checksummed; corruption quarantines and
+        returns None) and adopt it through ``import_session_entry`` — the
+        exact path a fleet handoff takes, so shared-head re-linking and
+        refcounts work identically. Returns True when the entry is now
+        resident in RAM."""
+        cache = self.session_cache
+        if cache is None or cache.disk is None or conversation_id not in cache.disk:
+            return False
+        with Timer(self.metrics, "finchat_durability_restore_seconds"):
+            payload = cache.disk.load(conversation_id)
+            if payload is None:
+                return False  # quarantined (corrupt/truncated): cold start
+            # an over-RAM-budget record is trimmed to the prefix that
+            # fits (partial warm resume); one that can't fit at all is
+            # dropped — put() would refuse it every turn, paying a full
+            # record read + rewrite for a guaranteed cold start
+            payload = cache.fit_payload(payload)
+            if payload is None:
+                cache.disk.discard(conversation_id)
+                return False
+            try:
+                # spill=False: these bytes just came OFF this disk tier —
+                # rewriting the identical record would double restore I/O
+                ok = self.import_session_entry(payload, spill=False)
+            except Exception as e:
+                logger.error("disk session restore failed for %s: %s",
+                             conversation_id, e)
+                return False
+        if ok:
+            self.metrics.inc("finchat_durability_disk_restores_total")
+        return ok
+
+    def spill_sessions(self) -> int:
+        """Write every resident session entry through to the disk tier
+        (graceful-shutdown tail; puts already write through, so this is a
+        retry/freshness pass)."""
+        if self.session_cache is None:
+            return 0
+        return self.session_cache.spill_all()
+
+    async def shutdown_drain(self) -> None:
+        """Graceful-shutdown tail (SIGTERM; serve/app.py drain_and_stop):
+        stop the loop, then preempt every straggler to host — its coherent
+        KV prefix is offloaded into the session tier (which writes through
+        to disk) before its slot and pages are released — and fail it with
+        a structured retryable ``shutting_down`` error, so its client
+        retries against the restarted process instead of hanging. Pending
+        never-admitted work fails the same way. Zero slot/page leaks by
+        construction: every live handle goes through ``_release``, and the
+        only pages still owned afterwards are the shared-prefix heads'
+        (device cache, dropped with the process)."""
+        await self.stop()
+        shutdown_error = {
+            "type": "error",
+            "message": "server shutting down; retry with backoff",
+            "code": "shutting_down", "retryable": True,
+        }
+        for handle in list(self.decoding.values()) + list(self.prefilling):
+            try:
+                # mid-decode stragglers have a coherent prompt+generated
+                # KV prefix — the same snapshot a normal retirement takes
+                self._maybe_offload(handle)
+            except Exception as e:
+                logger.error("shutdown offload failed for %s: %s",
+                             handle.seq_id, e)
+            self._release(handle)
+            handle.finished = True
+            handle.span.finish()
+            handle.events.put_nowait(dict(shutdown_error))
+        for handle in list(self.pending):
+            self.pending.remove(handle)
+            handle.finished = True
+            handle.span.finish()
+            handle.events.put_nowait(dict(shutdown_error))
+        self.metrics.set_gauge("finchat_queue_depth", 0)
+        self.spill_sessions()
 
     def _drain_to_sink(self) -> int:
         """Offer every pending handle — the just-preempted live streams

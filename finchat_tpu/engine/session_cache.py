@@ -29,6 +29,18 @@ sequence state streaming, SnapStream, arXiv:2511.03092):
 - LRU under a byte budget: host bytes are the sum of the entries' own-page
   snapshots; inserting past ``budget_bytes`` evicts least-recently-used
   conversations first.
+- DISK TIER (ISSUE 7; ROBUSTNESS.md §5): with ``engine.session_cache_disk_
+  path`` set, every stored entry is also written through to a checksummed,
+  versioned record file (atomic write-rename), the disk tier keeps its own
+  byte-budgeted LRU over those records, and a RAM miss at admission falls
+  through to disk (scheduler ``_restore_session_from_disk``). Because the
+  records are write-through — not written only at eviction — a full
+  process kill loses at most the turn that was mid-stream: the restarted
+  process sweeps the directory, rebuilds the index, and the next turn of
+  any retired conversation resumes warm. A corrupt or truncated record is
+  QUARANTINED (renamed aside, counted) and the conversation cold-starts;
+  stale or diverged records are harmless because every restore re-enters
+  ``match``'s token comparison and divergence truncation.
 
 Ownership contract (the allocator invariants of SURVEY §5.2 are untouched):
 the cache NEVER owns device pages. Snapshots are host copies taken while the
@@ -44,12 +56,20 @@ per-token ones.
 
 from __future__ import annotations
 
+import hashlib
+import json
+import os
+import threading
+import zlib
 from collections import OrderedDict
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
+from pathlib import Path
 from typing import Any, Callable
 
 import numpy as np
 
+from finchat_tpu.utils.faults import inject
 from finchat_tpu.utils.logging import get_logger
 from finchat_tpu.utils.metrics import METRICS
 
@@ -118,6 +138,372 @@ def _slice_snap(snap: tuple | None, n_pages: int) -> tuple | None:
     )
 
 
+class SessionDiskTier:
+    """Byte-budgeted LRU of session-KV record files under one directory —
+    the durability plane below the host-RAM tier (ISSUE 7).
+
+    Record format (version 1):
+
+        b"FSKV" | u8 version | u32 header_len | header JSON | payload
+
+    The header carries the cache key, ``prefix_len``, the array specs
+    (dtype/shape per array; the shared-prefix head's DEVICE pages are
+    never stored — the record is the ``export_entry`` payload shape, so
+    a restore re-links against the restoring scheduler's own live head),
+    the payload byte length, and a CRC32 of the payload. Writes go to a
+    ``.tmp`` sibling, fsync, then ``os.replace`` — a record is either
+    whole or absent, never torn. Any read-side anomaly (bad magic,
+    version, truncation, CRC mismatch, or an injected ``disk.restore``
+    fault) QUARANTINES the file (renamed ``*.quarantine``) and returns
+    None: never a crash, never stale KV — the conversation cold-starts.
+
+    Startup sweeps the directory: ``.tmp`` orphans from a mid-write crash
+    are deleted, records whose header or size don't parse are quarantined,
+    and the survivors rebuild the key index (LRU-ordered by mtime), so a
+    restarted process resumes conversations warm.
+
+    Writes are WRITE-BEHIND by default (``async_writes``): a record's
+    serialize + write + fsync is seconds-class I/O at real model sizes,
+    and the spill call sites sit inside the scheduler's event loop — the
+    same stall class PR 6 moved off-loop with ``revive_async`` — so
+    ``spill``/``discard`` enqueue onto ONE worker thread (FIFO, so a
+    discard can never be overtaken by an older write of the same key) and
+    return immediately. Snapshot arrays are safe to hand across: they are
+    never mutated in place (truncation REPLACES them — the
+    ``export_entry`` contract). ``load`` and ``flush`` drain the queue
+    first, and the graceful drain's ``spill_all`` flushes, so the
+    SIGTERM path stays fully durable; a hard kill can additionally lose
+    whatever was still queued — milliseconds of records, inside the
+    existing "at most the mid-stream turn" window.
+    """
+
+    MAGIC = b"FSKV"
+    VERSION = 1
+    SUFFIX = ".skv"
+
+    def __init__(self, path: str, budget_bytes: int, metrics=None,
+                 async_writes: bool = True):
+        assert budget_bytes > 0
+        self.path = Path(path)
+        self.path.mkdir(parents=True, exist_ok=True)
+        self.budget_bytes = budget_bytes
+        self.metrics = metrics if metrics is not None else METRICS
+        # key -> (filename, nbytes), LRU order (oldest first); guarded by
+        # _lock — the writer thread updates it as records land
+        self._index: OrderedDict[str, tuple[str, int]] = OrderedDict()
+        self._resident = 0
+        # key -> queued-write count: the index only reflects LANDED
+        # records, so membership checks must also see in-flight writes
+        # (a just-spilled, RAM-evicted entry would otherwise read as
+        # absent and cold-start), and load() need only pay the queue
+        # barrier when ITS key is actually pending
+        self._pending: dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._writer = (
+            ThreadPoolExecutor(max_workers=1, thread_name_prefix="skv-spill")
+            if async_writes else None
+        )
+        self._sweep()
+
+    # --- introspection ---------------------------------------------------
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._index)
+
+    def __contains__(self, key: str) -> bool:
+        with self._lock:
+            return key in self._index or key in self._pending
+
+    @property
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return self._resident
+
+    def _publish_gauges(self) -> None:
+        self.metrics.set_gauge("finchat_durability_disk_resident_bytes", self._resident)
+        self.metrics.set_gauge("finchat_durability_disk_entries", len(self._index))
+
+    @staticmethod
+    def _fname(key: str) -> str:
+        # the key is user-derived (conversation id + role suffix): hash it
+        # so it can never escape the directory or exceed filename limits
+        return hashlib.sha1(key.encode()).hexdigest() + SessionDiskTier.SUFFIX
+
+    # --- record (de)serialization ---------------------------------------
+    @staticmethod
+    def _serialize(key: str, token_ids: np.ndarray, prefix_len: int,
+                   snap: tuple | None) -> bytes:
+        token_ids = np.ascontiguousarray(token_ids, np.int32)
+        chunks = [token_ids.tobytes()]
+        specs: list[dict | None] | None = None
+        if snap is not None:
+            specs = []
+            for a in snap:
+                if a is None:
+                    specs.append(None)
+                    continue
+                a = np.ascontiguousarray(a)
+                specs.append({"dtype": a.dtype.str, "shape": list(a.shape)})
+                chunks.append(a.tobytes())
+        payload = b"".join(chunks)
+        header = json.dumps({
+            "key": key,
+            "prefix_len": int(prefix_len),
+            "n_tokens": int(token_ids.shape[0]),
+            "snap": specs,
+            "payload_len": len(payload),
+            "crc": zlib.crc32(payload),
+        }).encode()
+        return (SessionDiskTier.MAGIC + bytes([SessionDiskTier.VERSION])
+                + len(header).to_bytes(4, "big") + header + payload)
+
+    @staticmethod
+    def _read_header(raw: bytes) -> tuple[dict, int]:
+        """(header, payload offset); raises ValueError on any anomaly."""
+        if raw[:4] != SessionDiskTier.MAGIC:
+            raise ValueError("bad magic")
+        if raw[4] != SessionDiskTier.VERSION:
+            raise ValueError(f"unknown record version {raw[4]}")
+        hlen = int.from_bytes(raw[5:9], "big")
+        header = json.loads(raw[9 : 9 + hlen].decode())
+        off = 9 + hlen
+        if len(raw) - off != header["payload_len"]:
+            raise ValueError("truncated record")
+        return header, off
+
+    @staticmethod
+    def _deserialize(raw: bytes) -> dict:
+        header, off = SessionDiskTier._read_header(raw)
+        payload = raw[off:]
+        if zlib.crc32(payload) != header["crc"]:
+            raise ValueError("payload checksum mismatch")
+        n = header["n_tokens"]
+        token_ids = np.frombuffer(payload, np.int32, count=n)
+        pos = n * 4
+        snap = None
+        if header["snap"] is not None:
+            arrs = []
+            for spec in header["snap"]:
+                if spec is None:
+                    arrs.append(None)
+                    continue
+                dt = np.dtype(spec["dtype"])
+                count = int(np.prod(spec["shape"])) if spec["shape"] else 1
+                arrs.append(
+                    np.frombuffer(payload, dt, count=count, offset=pos)
+                    .reshape(spec["shape"])
+                )
+                pos += count * dt.itemsize
+            snap = tuple(arrs)
+        return {
+            "conversation_id": header["key"],
+            "token_ids": token_ids,
+            "prefix_len": int(header["prefix_len"]),
+            "snap": snap,
+        }
+
+    # --- write path ------------------------------------------------------
+    def spill(self, key: str, token_ids: np.ndarray, prefix_len: int,
+              snap: tuple | None) -> bool:
+        """Record one entry (atomic write-rename), then LRU-evict records
+        past the byte budget. Write-behind: the serialize + fsync runs on
+        the writer thread and this returns immediately (True = accepted);
+        a failed write (disk full, injected ``disk.spill`` fault) logs and
+        counts on ``finchat_durability_spill_failures_total`` — the
+        serving path never fails, and never waits, on durability I/O."""
+        if self._writer is not None:
+            with self._lock:
+                self._pending[key] = self._pending.get(key, 0) + 1
+            self._writer.submit(self._write_record, key, token_ids,
+                                prefix_len, snap)
+            return True
+        return self._write_record(key, token_ids, prefix_len, snap)
+
+    def _unpend(self, key: str) -> None:
+        """One queued write for ``key`` finished (landed or failed)."""
+        if self._writer is None:
+            return
+        with self._lock:
+            n = self._pending.get(key, 0) - 1
+            if n <= 0:
+                self._pending.pop(key, None)
+            else:
+                self._pending[key] = n
+
+    def _write_record(self, key: str, token_ids: np.ndarray, prefix_len: int,
+                      snap: tuple | None) -> bool:
+        """Writer-thread body (inline when ``async_writes`` is off)."""
+        fname = self._fname(key)
+        final = self.path / fname
+        tmp = self.path / (fname + ".tmp")
+        try:
+            inject("disk.spill", key=key)
+            blob = self._serialize(key, token_ids, prefix_len, snap)
+            with open(tmp, "wb") as f:
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, final)
+        except Exception as e:
+            logger.error("session disk tier: spill of %s failed: %s", key, e)
+            self.metrics.inc("finchat_durability_spill_failures_total")
+            tmp.unlink(missing_ok=True)
+            self._unpend(key)
+            return False
+        victims: list[tuple[str, str, int]] = []
+        with self._lock:
+            old = self._index.pop(key, None)
+            if old is not None:
+                self._resident -= old[1]
+            self._index[key] = (fname, len(blob))
+            self._resident += len(blob)
+            n = self._pending.get(key, 0) - 1
+            if n <= 0:
+                self._pending.pop(key, None)
+            else:
+                self._pending[key] = n
+            while self._resident > self.budget_bytes and len(self._index) > 1:
+                victim_key, (victim_fname, victim_bytes) = next(iter(self._index.items()))
+                del self._index[victim_key]
+                self._resident -= victim_bytes
+                victims.append((victim_key, victim_fname, victim_bytes))
+        self.metrics.inc("finchat_durability_spills_total")
+        self.metrics.inc("finchat_durability_spilled_bytes_total", len(blob))
+        for victim_key, victim_fname, victim_bytes in victims:
+            (self.path / victim_fname).unlink(missing_ok=True)
+            self.metrics.inc("finchat_durability_disk_evictions_total")
+            logger.debug("session disk tier: evicted %s (LRU, %d bytes)",
+                         victim_key, victim_bytes)
+        self._publish_gauges()
+        return True
+
+    def discard(self, key: str) -> None:
+        """Drop a key's record. Rides the writer queue (FIFO), so it can
+        never be overtaken by an older queued write of the same key — and
+        ``load`` flushes first, so a discarded record is unreachable the
+        moment any reader could look for it."""
+        if self._writer is not None:
+            # pending too: a load between enqueue and unlink must barrier
+            # and observe the pop, not read the doomed record
+            with self._lock:
+                self._pending[key] = self._pending.get(key, 0) + 1
+            self._writer.submit(self._discard_now, key)
+        else:
+            self._discard_now(key)
+
+    def _discard_now(self, key: str) -> None:
+        with self._lock:
+            entry = self._index.pop(key, None)
+            if entry is not None:
+                self._resident -= entry[1]
+        self._unpend(key)
+        if entry is not None:
+            (self.path / entry[0]).unlink(missing_ok=True)
+            self._publish_gauges()
+
+    def flush(self) -> None:
+        """Wait for every queued write/discard to land (graceful drain;
+        read-side ops that must observe prior writes). FIFO barrier: the
+        single worker makes one no-op submission a full drain."""
+        if self._writer is not None:
+            self._writer.submit(lambda: None).result()
+
+    def close(self) -> None:
+        if self._writer is not None:
+            self._writer.shutdown(wait=True)
+            self._writer = None
+
+    # --- read path -------------------------------------------------------
+    def load(self, key: str) -> dict | None:
+        """Read, verify, and decode one record: an ``export_entry``-shaped
+        payload, or None (absent / quarantined). A hit refreshes LRU
+        recency; the record stays on disk (the RAM copy may be evicted or
+        lost again before the next spill overwrites it)."""
+        with self._lock:
+            pending = key in self._pending
+        if pending:
+            # barrier only when THIS key has a queued write: a full-queue
+            # flush on every RAM-miss admission would stall the scheduler
+            # loop behind every unrelated spill in flight
+            self.flush()
+        with self._lock:
+            entry = self._index.get(key)
+        if entry is None:
+            return None
+        try:
+            inject("disk.restore", key=key)
+            payload = self._deserialize((self.path / entry[0]).read_bytes())
+            if payload["conversation_id"] != key:
+                raise ValueError("record key mismatch")
+        except Exception as e:
+            logger.error(
+                "session disk tier: record for %s unreadable (%s); "
+                "quarantining — conversation cold-starts", key, e,
+            )
+            self._quarantine(key)
+            return None
+        with self._lock:
+            if key in self._index:
+                self._index.move_to_end(key)
+        return payload
+
+    def _quarantine(self, key: str, fname: str | None = None) -> None:
+        with self._lock:
+            entry = self._index.pop(key, None)
+            if entry is not None:
+                fname, nbytes = entry
+                self._resident -= nbytes
+        if fname is not None:
+            src = self.path / fname
+            try:
+                os.replace(src, self.path / (fname + ".quarantine"))
+            except OSError:
+                src.unlink(missing_ok=True)
+        self.metrics.inc("finchat_durability_quarantines_total")
+        self._publish_gauges()
+
+    # --- startup ---------------------------------------------------------
+    def _sweep(self) -> None:
+        """Rebuild the index from the directory: delete ``.tmp`` orphans
+        (a crash mid-write), quarantine records whose header or size don't
+        parse (full CRC verification is deferred to load — the sweep stays
+        O(header) per record), index the rest LRU-ordered by mtime."""
+        found: list[tuple[float, str, str, int]] = []  # (mtime, key, fname, nbytes)
+        for p in self.path.iterdir():
+            name = p.name
+            if name.endswith(".tmp"):
+                p.unlink(missing_ok=True)  # orphaned partial write
+                continue
+            if not name.endswith(self.SUFFIX):
+                continue  # quarantined or foreign file
+            try:
+                with open(p, "rb") as f:
+                    head = f.read(9)
+                    if head[:4] != self.MAGIC or head[4] != self.VERSION:
+                        raise ValueError("bad magic/version")
+                    hlen = int.from_bytes(head[5:9], "big")
+                    header = json.loads(f.read(hlen).decode())
+                size = p.stat().st_size
+                if size != 9 + hlen + header["payload_len"]:
+                    raise ValueError("size mismatch")
+                found.append((p.stat().st_mtime, header["key"], name, size))
+            except Exception as e:
+                logger.error("session disk tier: sweeping out bad record %s "
+                             "(%s)", name, e)
+                try:
+                    os.replace(p, self.path / (name + ".quarantine"))
+                except OSError:
+                    p.unlink(missing_ok=True)
+                self.metrics.inc("finchat_durability_quarantines_total")
+        for _mtime, key, fname, nbytes in sorted(found):
+            self._index[key] = (fname, nbytes)
+            self._resident += nbytes
+        if self._index:
+            logger.info("session disk tier: %d resumable records (%d bytes) "
+                        "at %s", len(self._index), self._resident, self.path)
+        self._publish_gauges()
+
+
 @dataclass
 class SessionEntry:
     """One retired conversation's resumable KV.
@@ -161,7 +547,7 @@ class SessionKVCache:
 
     def __init__(self, budget_bytes: int, page_size: int,
                  on_drop: Callable[[SessionEntry], None] | None = None,
-                 metrics=None):
+                 metrics=None, disk: SessionDiskTier | None = None):
         assert budget_bytes > 0 and page_size > 0
         self.budget_bytes = budget_bytes
         self.page_size = page_size
@@ -169,6 +555,12 @@ class SessionKVCache:
         # a fleet replica passes METRICS.labeled(replica=...) so its cache
         # series separate from its siblings'; default is the global registry
         self.metrics = metrics if metrics is not None else METRICS
+        # durability plane (ISSUE 7): entries write THROUGH to the disk
+        # tier at put — not only at eviction — so a process kill loses at
+        # most the mid-stream turn, and a RAM miss falls through to disk
+        # via the scheduler (_restore_session_from_disk, which re-links
+        # shared heads); None = host-RAM only (pre-ISSUE-7 behavior)
+        self.disk = disk
         self._entries: OrderedDict[str, SessionEntry] = OrderedDict()
         self._resident_bytes = 0
         self._publish_gauges()
@@ -189,10 +581,19 @@ class SessionKVCache:
         self.metrics.set_gauge("finchat_session_cache_entries", len(self._entries))
 
     # --- write path ------------------------------------------------------
-    def put(self, entry: SessionEntry) -> bool:
+    def put(self, entry: SessionEntry, *, spill: bool = True) -> bool:
         """Insert (replacing any previous entry for the conversation),
         then LRU-evict others until the byte budget holds. Returns False —
-        and drops nothing — when the entry alone exceeds the budget."""
+        and drops nothing from RAM — when the entry alone exceeds the
+        budget. With a disk tier, the entry writes through to its record
+        file either way: an over-budget entry is still resumable from disk
+        (``fit_payload`` trims it back under the RAM budget at restore —
+        the millions-of-idle-conversations case, ROADMAP item 4), and a
+        stored one survives a process kill. ``spill=False`` is the
+        disk-RESTORE path: the bytes just came off that record, so
+        rewriting them would double every restore's I/O for nothing."""
+        if spill:
+            self._spill(entry)
         if entry.nbytes > self.budget_bytes:
             logger.warning(
                 "session cache: entry for %s (%d bytes) exceeds budget %d; not stored",
@@ -215,6 +616,12 @@ class SessionKVCache:
         return True
 
     def discard(self, conversation_id: str) -> None:
+        """Drop a conversation's entry from BOTH tiers — used when the
+        bytes move elsewhere (fleet migration / drain handoff): a disk
+        twin left behind could later restore on a replica the conversation
+        no longer routes to."""
+        if self.disk is not None:
+            self.disk.discard(conversation_id)
         entry = self._entries.pop(conversation_id, None)
         if entry is not None:
             self._drop(entry)
@@ -246,6 +653,62 @@ class SessionKVCache:
         if self._on_drop is not None:
             self._on_drop(entry)
 
+    # --- disk tier (ISSUE 7) ---------------------------------------------
+    def _spill(self, entry: SessionEntry) -> bool:
+        """Write one entry's record through to the disk tier (no-op
+        without one). The record is the ``export_entry`` payload shape —
+        ``prefix_len`` travels, the head's device pages never do — so a
+        restore re-links against the restoring scheduler's own live
+        head."""
+        if self.disk is None or entry.n_tokens == 0:
+            return False
+        return self.disk.spill(
+            entry.conversation_id, entry.token_ids, entry.prefix_len, entry.snap
+        )
+
+    def spill_all(self) -> int:
+        """Re-spill every resident entry (graceful-shutdown drain): puts
+        already wrote through, so this is a retry pass for any spill that
+        failed transiently plus a freshness pass for entries truncated
+        since. Flushes the write-behind queue — the SIGTERM path exits
+        fully durable. Returns how many records were written."""
+        n = sum(1 for e in self._entries.values() if self._spill(e))
+        if self.disk is not None:
+            self.disk.flush()
+        return n
+
+    def fit_payload(self, payload: dict) -> dict | None:
+        """Trim a disk/exported payload to the largest page-whole prefix
+        whose host bytes fit the RAM budget, so an over-budget record is
+        still (partially) resumable instead of being refused by ``put``
+        on every turn — per-turn full-record churn that never warms
+        anything. Snapshot pages are uniform-size, so the byte budget maps
+        directly to a page count. Returns the payload untouched when it
+        fits, a trimmed copy when a prefix does, or None when nothing
+        does (no shared head, not one page under budget) — the caller
+        should drop the record rather than retry forever."""
+        snap = payload["snap"]
+        nbytes = _snap_nbytes(snap)
+        if nbytes <= self.budget_bytes:
+            return payload
+        prefix_len = int(payload["prefix_len"])
+        own_pages = (len(payload["token_ids"]) - prefix_len) // self.page_size
+        keep = int(own_pages * self.budget_bytes // nbytes)
+        if keep <= 0 and prefix_len <= 0:
+            return None
+        trimmed = dict(payload)
+        trimmed["token_ids"] = np.asarray(payload["token_ids"], np.int32)[
+            : prefix_len + keep * self.page_size
+        ]
+        trimmed["snap"] = _slice_snap(snap, keep)
+        logger.warning(
+            "session cache: disk record for %s (%d bytes) exceeds RAM "
+            "budget %d; trimmed to %d of %d own pages for a partial warm "
+            "resume", payload["conversation_id"], nbytes, self.budget_bytes,
+            keep, own_pages,
+        )
+        return trimmed
+
     # --- cross-replica migration (serve/fleet.py; ISSUE 6) ---------------
     def export_entry(self, conversation_id: str) -> dict | None:
         """Portable, device-independent image of one conversation's entry
@@ -269,7 +732,8 @@ class SessionKVCache:
         }
 
     def import_entry(self, payload: dict, *, prefix_entry: Any | None = None,
-                     prefix_pages: list[int] | None = None) -> bool:
+                     prefix_pages: list[int] | None = None,
+                     spill: bool = True) -> bool:
         """Adopt an exported entry. ``prefix_entry``/``prefix_pages`` is
         the importer's OWN live twin of the exported shared head —
         resolved, validated, and refcounted by the scheduler — covering
@@ -286,7 +750,7 @@ class SessionKVCache:
             prefix_len=prefix_len,
             snap=payload["snap"],
         )
-        return self.put(entry)
+        return self.put(entry, spill=spill)
 
     # --- read path -------------------------------------------------------
     def match(self, conversation_id: str, prompt_ids: list[int]) -> tuple[SessionEntry | None, int]:

@@ -1,0 +1,185 @@
+"""Answered-message journal (ISSUE 7; ROBUSTNESS.md §5).
+
+The at-least-once plane (PR 5/6) dedupes redelivered ``message_id``s
+through an in-memory ring — which dies with the process, so a crash plus
+Kafka redelivery of an answered-but-uncommitted message could double-answer
+a conversation (the trade ROBUSTNESS.md used to document). This journal
+closes it:
+
+- ``append(message_id)`` writes one checksummed line and fsyncs BEFORE the
+  app commits the message's Kafka offset (serve/app.py ``_done``). The
+  ordering is the whole contract: if the process dies between the answer
+  and the commit, the redelivered message finds its id in the replayed
+  journal and is skipped; if it dies between the fsync and the answer's
+  last produce... there is no such window — the id is appended only after
+  the stream COMPLETED.
+- Failed / shed / timed-out ids are never journaled (the app journals only
+  answered ones), so a producer retrying a retryable error is reprocessed.
+- ``replay()`` at startup parses the journal, skipping corrupt records
+  (a torn final line after a crash is expected; each skip is counted, the
+  rest of the file is still honored — never a crash, never a lost id that
+  parsed), compacts the file to the most recent ``keep`` distinct ids
+  (matching the dedupe ring's bound — older ids have aged out of the ring
+  anyway), and returns them for the caller to seed the fleet-wide
+  ``DedupeRing`` (serve/fleet.py).
+
+Line format: ``v1 <crc32 hex> <json message_id>\\n`` — the CRC covers the
+JSON payload, so a half-written or bit-flipped line never replays as a
+different id.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from pathlib import Path
+
+from finchat_tpu.utils.faults import inject
+from finchat_tpu.utils.logging import get_logger
+from finchat_tpu.utils.metrics import METRICS
+
+logger = get_logger(__name__)
+
+_BAD = object()
+
+
+class AnsweredJournal:
+    """Append-only, fsync-before-commit record of answered message ids."""
+
+    FILENAME = "answered.journal"
+
+    def __init__(self, dir_path: str, *, fsync: bool = True, keep: int = 1024,
+                 metrics=None):
+        self.dir = Path(dir_path)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.path = self.dir / self.FILENAME
+        self.fsync = fsync
+        self.keep = keep
+        self.metrics = metrics if metrics is not None else METRICS
+        self._fh = None
+        # in-process compaction bound: the ring only ever holds ``keep``
+        # ids, so a journal much larger than that is pure dead weight
+        self._appends_since_compact = 0
+
+    # --- record codec ----------------------------------------------------
+    @staticmethod
+    def _encode(message_id) -> bytes:
+        payload = json.dumps(message_id).encode()
+        return b"v1 %08x " % zlib.crc32(payload) + payload + b"\n"
+
+    @staticmethod
+    def _decode(line: bytes):
+        """The id, or the ``_BAD`` sentinel for a corrupt/torn record."""
+        parts = line.split(b" ", 2)
+        if len(parts) != 3 or parts[0] != b"v1":
+            return _BAD
+        try:
+            if int(parts[1], 16) != zlib.crc32(parts[2]):
+                return _BAD
+            return json.loads(parts[2].decode())
+        except (ValueError, UnicodeDecodeError):
+            return _BAD
+
+    # --- write path ------------------------------------------------------
+    def append(self, message_id) -> bool:
+        """Durably record an ANSWERED id. Best-effort by contract: a
+        failure (disk full, injected ``journal.append`` fault) logs and
+        returns False — the answer already streamed, and refusing to
+        commit over a journal error would wedge the partition; the cost
+        of the miss is one possible duplicate answer after a crash,
+        exactly the pre-journal trade."""
+        try:
+            inject("journal.append", message_id=message_id)
+            if self._fh is None:
+                self._fh = open(self.path, "ab")
+            self._fh.write(self._encode(message_id))
+            self._fh.flush()
+            if self.fsync:
+                os.fsync(self._fh.fileno())
+        except Exception as e:
+            logger.error("answered journal: append of %r failed: %s",
+                         message_id, e)
+            self.metrics.inc("finchat_durability_journal_append_failures_total")
+            return False
+        self.metrics.inc("finchat_durability_journal_appends_total")
+        self._appends_since_compact += 1
+        if self._appends_since_compact >= 8 * self.keep:
+            self._compact()
+        return True
+
+    # --- startup / maintenance -------------------------------------------
+    def _read(self) -> list:
+        """Parse every intact record in file order; corrupt ones are
+        skipped and counted (a torn tail after a crash is the normal
+        case, a corrupt middle record the injected one)."""
+        if not self.path.exists():
+            return []
+        ids: list = []
+        corrupt = 0
+        for line in self.path.read_bytes().split(b"\n"):
+            if not line:
+                continue
+            mid = self._decode(line)
+            if mid is _BAD:
+                corrupt += 1
+                continue
+            ids.append(mid)
+        if corrupt:
+            logger.warning(
+                "answered journal: skipped %d corrupt record(s) at %s "
+                "(torn tail after a crash is expected; the intact records "
+                "still replay)", corrupt, self.path,
+            )
+            self.metrics.inc("finchat_durability_quarantines_total", corrupt)
+        return ids
+
+    @staticmethod
+    def _last_distinct(ids: list, keep: int) -> list:
+        """Most recent ``keep`` distinct ids, oldest-first (a re-answered
+        retry's LATEST append wins its slot, matching ring recency)."""
+        seen: dict = {}
+        for i, mid in enumerate(ids):
+            seen[json.dumps(mid)] = i
+        order = sorted(seen.values())[-keep:]
+        return [ids[i] for i in order]
+
+    def _rewrite(self, ids: list) -> None:
+        tmp = self.path.with_suffix(".tmp")
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+        with open(tmp, "wb") as f:
+            for mid in ids:
+                f.write(self._encode(mid))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+        self._appends_since_compact = 0
+
+    def _compact(self) -> None:
+        try:
+            self._rewrite(self._last_distinct(self._read(), self.keep))
+        except Exception as e:
+            logger.error("answered journal: compaction failed: %s", e)
+
+    def replay(self) -> list:
+        """Startup: the most recent ``keep`` distinct answered ids,
+        oldest-first — seed them into the dedupe ring in order so ring
+        recency matches journal recency. Also compacts the file (drops
+        aged-out ids and the torn tail)."""
+        ids = self._last_distinct(self._read(), self.keep)
+        try:
+            self._rewrite(ids)
+        except Exception as e:
+            logger.error("answered journal: post-replay compaction failed: %s", e)
+        if ids:
+            self.metrics.inc("finchat_durability_journal_replayed_total", len(ids))
+            logger.info("answered journal: replayed %d answered message id(s) "
+                        "into the dedupe ring", len(ids))
+        return ids
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None

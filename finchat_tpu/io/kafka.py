@@ -144,14 +144,44 @@ class _GroupState:
 
 class InMemoryBroker:
     """In-process broker: topics × partitions, consumer groups, committed
-    offsets. Thread-safe; shared by all clients in a process."""
+    offsets. Thread-safe; shared by all clients in a process.
 
-    def __init__(self, num_partitions: int = DEFAULT_NUM_PARTITIONS):
+    ``offsets_dir`` (ISSUE 7 satellite; defaults to the journal dir via
+    KafkaConfig.offsets_dir): committed group offsets persist to
+    ``kafka_offsets.json`` there, and a FRESH broker instance loads them
+    at construction — so a restart drill that re-produces the same
+    records rewinds to the committed watermark exactly like a rejoining
+    real consumer group, redelivering only the uncommitted tail. A
+    persisted offset beyond a (shorter) fresh log warns and clamps."""
+
+    OFFSETS_FILENAME = "kafka_offsets.json"
+
+    def __init__(self, num_partitions: int = DEFAULT_NUM_PARTITIONS,
+                 offsets_dir: str | None = None):
         self.num_partitions = num_partitions
         self._lock = threading.Lock()
         self._topics: dict[str, list[_PartitionLog]] = {}
         self._groups: dict[str, _GroupState] = {}
         self.faults = FaultInjection()
+        self._offsets_path = None
+        # group -> {"topic:partition": committed next offset}
+        self._persisted: dict[str, dict[str, int]] = {}
+        if offsets_dir:
+            import pathlib
+
+            d = pathlib.Path(offsets_dir)
+            d.mkdir(parents=True, exist_ok=True)
+            self._offsets_path = d / self.OFFSETS_FILENAME
+            try:
+                if self._offsets_path.exists():
+                    self._persisted = json.loads(self._offsets_path.read_text())
+                    logger.info("kafka: loaded persisted committed offsets "
+                                "from %s", self._offsets_path)
+            except Exception as e:
+                logger.warning("kafka: persisted offsets at %s unreadable "
+                               "(%s); starting from scratch",
+                               self._offsets_path, e)
+                self._persisted = {}
 
     def _partition_for(self, key: str | None) -> int:
         return partition_for_key(key, self.num_partitions)
@@ -179,7 +209,28 @@ class InMemoryBroker:
                 for part, log in enumerate(logs):
                     tp = (topic, part)
                     if tp not in group.offsets:
-                        group.offsets[tp] = len(log.records) if offset_reset == "latest" else 0
+                        saved = self._persisted.get(group_id, {}).get(
+                            f"{topic}:{part}"
+                        )
+                        if saved is not None:
+                            # restart drill (ISSUE 7): a fresh broker with
+                            # persisted offsets resumes at the committed
+                            # watermark, like a rejoining consumer group
+                            if saved > len(log.records):
+                                logger.warning(
+                                    "kafka: persisted committed offset %d "
+                                    "for %s[%d] is beyond the log (%d "
+                                    "records); clamping — the fresh broker "
+                                    "holds fewer records than the one that "
+                                    "committed", saved, topic, part,
+                                    len(log.records),
+                                )
+                                saved = len(log.records)
+                            group.offsets[tp] = saved
+                        else:
+                            group.offsets[tp] = (
+                                len(log.records) if offset_reset == "latest" else 0
+                            )
                     # a (re)join rewinds the position to the committed
                     # offset — the rebalance semantics that make manual
                     # commit at-least-once (uncommitted records redeliver)
@@ -235,6 +286,27 @@ class InMemoryBroker:
                 return
             tp = (topic, partition)
             group.offsets[tp] = max(group.offsets.get(tp, 0), next_offset)
+            if self._offsets_path is not None:
+                self._persisted.setdefault(group_id, {})[
+                    f"{topic}:{partition}"
+                ] = group.offsets[tp]
+                self._persist_offsets()
+
+    def _persist_offsets(self) -> None:
+        """Atomic write-rename of the committed-offsets map (lock held).
+        Best-effort: a failed write costs redelivery depth on the next
+        restart, never correctness (the journal dedupes answered ids)."""
+        tmp = self._offsets_path.with_suffix(".tmp")
+        try:
+            import os
+
+            with open(tmp, "w") as f:
+                f.write(json.dumps(self._persisted))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._offsets_path)
+        except Exception as e:
+            logger.error("kafka: persisting committed offsets failed: %s", e)
 
     # --- test/introspection helpers -------------------------------------
     def drain(self, topic: str) -> list[Message]:
@@ -249,16 +321,18 @@ _PROCESS_BROKER: InMemoryBroker | None = None
 _PROCESS_BROKER_LOCK = threading.Lock()
 
 
-def default_broker(num_partitions: int = DEFAULT_NUM_PARTITIONS) -> InMemoryBroker:
+def default_broker(num_partitions: int = DEFAULT_NUM_PARTITIONS,
+                   offsets_dir: str | None = None) -> InMemoryBroker:
     """Process-wide shared broker for the memory backend, so independently
     constructed producers and consumers in one process see each other.
-    ``num_partitions`` applies only when THIS call creates the broker
-    (kafka.num_partitions, via the first KafkaClient); later callers share
-    it as-is — a mismatch warns at client construction."""
+    ``num_partitions`` / ``offsets_dir`` apply only when THIS call creates
+    the broker (kafka.num_partitions / kafka.offsets_dir, via the first
+    KafkaClient); later callers share it as-is — a partition-count
+    mismatch warns at client construction."""
     global _PROCESS_BROKER
     with _PROCESS_BROKER_LOCK:
         if _PROCESS_BROKER is None:
-            _PROCESS_BROKER = InMemoryBroker(num_partitions)
+            _PROCESS_BROKER = InMemoryBroker(num_partitions, offsets_dir=offsets_dir)
         return _PROCESS_BROKER
 
 
@@ -282,7 +356,10 @@ class KafkaClient:
             self._producer = confluent_kafka.Producer(self.config.librdkafka_config())
             self._consumer = None
         else:
-            self._broker = broker or default_broker(self.config.num_partitions)
+            self._broker = broker or default_broker(
+                self.config.num_partitions,
+                offsets_dir=self.config.offsets_dir or None,
+            )
             self._producer = None
             self._consumer = None
             if self._broker.num_partitions != self.config.num_partitions:

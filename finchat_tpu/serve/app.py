@@ -410,6 +410,27 @@ class App:
         # ring size's single source of truth is the DedupeRing default,
         # so the fleet's shared ring and this one can never drift
         self._dedupe = fleet.dedupe if fleet is not None else DedupeRing()
+        # answered-message journal (io/journal.py — ISSUE 7): answered ids
+        # fsync to disk BEFORE their Kafka offset commits, and a restart
+        # replays them into the ring, so crash + redelivery cannot
+        # double-answer. Failed ids are never journaled (see _done).
+        self._journal = None
+        if cfg.journal.path:
+            from finchat_tpu.io.journal import AnsweredJournal
+
+            try:
+                self._journal = AnsweredJournal(
+                    cfg.journal.path, fsync=cfg.journal.fsync,
+                    keep=self._dedupe.size,
+                )
+                self._dedupe.preload(self._journal.replay())
+            except Exception as e:  # durability is best-effort
+                logger.error("answered journal unavailable at %s: %s",
+                             cfg.journal.path, e)
+                self._journal = None
+        # graceful SIGTERM drain (ISSUE 7): set while drain_and_stop runs
+        # so the HTTP chat paths stop admitting with a retryable 503
+        self._draining = False
 
     # --- lifespan -------------------------------------------------------
     def _embed_batcher(self):
@@ -482,6 +503,72 @@ class App:
         self._persist_index(force=True)
         await self.server.stop()
         self.kafka.close()
+        if self._journal is not None:
+            self._journal.close()
+
+    def _all_schedulers(self) -> list:
+        if self.fleet is not None:
+            return [rep.scheduler for rep in self.fleet.replicas]
+        return [self.scheduler] if self.scheduler is not None else []
+
+    async def drain_and_stop(self) -> None:
+        """Graceful SIGTERM shutdown (ISSUE 7; ROBUSTNESS.md §5): stop
+        admission (Kafka polling halts, HTTP chat returns a retryable
+        503), let in-flight streams COMPLETE within
+        ``shutdown.deadline_seconds`` (their answers journal and their
+        offsets commit exactly as in steady state), then preempt the
+        stragglers to host — each one's coherent KV spills through the
+        session disk tier and its client gets a retryable
+        ``shutting_down`` error — spill every session entry, and exit
+        with zero slot/page leaks. The restarted process replays the
+        journal, rewinds to the committed watermark, and resumes
+        conversations warm from the disk tier."""
+        t0 = time.perf_counter()
+        METRICS.inc("finchat_durability_graceful_drains_total")
+        self._draining = True
+        self._running = False
+        if self._consume_task:
+            self._consume_task.cancel()
+            try:
+                await self._consume_task
+            except asyncio.CancelledError:
+                pass
+            self._consume_task = None
+        deadline = max(0.0, self.cfg.shutdown.deadline_seconds)
+        if self._inflight:
+            _done, stragglers = await asyncio.wait(
+                set(self._inflight), timeout=deadline
+            )
+            if stragglers:
+                logger.warning(
+                    "graceful drain: %d in-flight message(s) past the "
+                    "%.1fs deadline; preempting to host", len(stragglers),
+                    deadline,
+                )
+        # the fleet supervisor must be down before the per-replica drain:
+        # a respawn's device rebuild (revive_async) racing shutdown_drain
+        # on the same engine could corrupt allocator/slot state and defeat
+        # the zero-leak exit (fleet.stop later is an idempotent no-op for
+        # the already-cleared tasks)
+        if self.fleet is not None:
+            await self.fleet.stop_supervisor()
+        # stragglers' engine handles fail with the retryable shutting_down
+        # error and their coherent KV spills to the session tier; the loop
+        # stops first, so no dispatch races the offload
+        for sched in self._all_schedulers():
+            try:
+                await sched.shutdown_drain()
+            except Exception as e:
+                logger.error("scheduler shutdown drain failed: %s", e)
+        # the straggler tasks observe the error events, emit their
+        # retryable error chunks, and complete — committing their offsets
+        if self._inflight:
+            await asyncio.gather(*self._inflight, return_exceptions=True)
+        METRICS.observe(
+            "finchat_durability_shutdown_drain_seconds",
+            time.perf_counter() - t0,
+        )
+        await self.stop()
 
     # snapshots are full rewrites (np.savez over the whole collection), so
     # debounce streaming-ingest saves; shutdown always forces one
@@ -607,9 +694,15 @@ class App:
         return self._dedupe._ids
 
     # --- conversation plumbing ------------------------------------------
-    @staticmethod
-    def _payload_error(payload: dict) -> Response | None:
-        """Shared HTTP validation for the chat endpoints."""
+    def _payload_error(self, payload: dict) -> Response | None:
+        """Shared HTTP validation for the chat endpoints; also the
+        admission gate during a graceful drain (new work gets a retryable
+        503 while in-flight streams finish)."""
+        if self._draining:
+            return Response.json(
+                {"detail": "server shutting down; retry with backoff",
+                 "retryable": True}, status=503,
+            )
         missing = [k for k in ("conversation_id", "message", "user_id") if k not in payload]
         if missing:
             return Response.json({"detail": f"missing fields: {missing}"}, status=400)
@@ -963,6 +1056,12 @@ class App:
                 # producer retry (the retryable error chunk's invitation)
                 # is reprocessed instead of black-holed
                 self._dedupe.forget(mid)
+            elif mid is not None and self._journal is not None:
+                # ANSWERED: journal the id — fsync completes BEFORE the
+                # watermark commit below, so a crash between them
+                # redelivers the message to a process that already knows
+                # it was answered (ISSUE 7; ROBUSTNESS.md §5)
+                self._journal.append(mid)
             # the watchdog-wrapped handler completed (answered, errored, or
             # timed out with the timeout chunk emitted): only now may this
             # offset count toward the committed watermark

@@ -107,8 +107,9 @@ class DedupeRing:
     shared across the fleet, a replica crash plus Kafka redelivery of its
     uncommitted messages to a sibling replica cannot double-answer a
     conversation — the sibling consults the same ring the dead replica's
-    answers were recorded in. (Across PROCESSES the at-least-once trade
-    documented in serve/app.py still applies.)"""
+    answers were recorded in. Across PROCESSES, the answered-message
+    journal (io/journal.py; ISSUE 7) replays into this ring at startup via
+    ``preload``, closing the crash-redelivery window too."""
 
     def __init__(self, size: int = 1024):
         self.size = size
@@ -125,6 +126,12 @@ class DedupeRing:
         if len(self._ring) > self.size:
             self._ids.discard(self._ring.popleft())
         return False
+
+    def preload(self, message_ids) -> int:
+        """Seed the ring with journaled answered ids at startup (ISSUE 7:
+        the answered-message journal replays here, oldest-first, so ring
+        recency matches journal recency). Returns how many were new."""
+        return sum(1 for mid in message_ids if not self.seen(mid))
 
     def forget(self, message_id) -> None:
         """Drop an id whose handling FAILED (never answered), so a
@@ -358,7 +365,15 @@ class EngineFleet:
         if self.cfg.respawn and len(self.replicas) > 1:
             self._supervisor_task = asyncio.create_task(self._supervise())
 
-    async def stop(self) -> None:
+    async def stop_supervisor(self) -> None:
+        """Cancel the supervisor and in-flight respawns WITHOUT stopping
+        the replicas. The graceful drain calls this before per-replica
+        ``shutdown_drain`` so a respawn's device rebuild can't race the
+        drain's offload/release on the same engine (serve/app.py
+        ``drain_and_stop``). A ``revive_async`` rebuild already past its
+        cancellation point finishes on its worker thread — harmless: a
+        RESPAWNING replica holds no live sequences, and its cancelled
+        task never runs ``_revive_commit``."""
         self._running = False
         for task in (*self._respawn_tasks,
                      *([self._supervisor_task] if self._supervisor_task else ())):
@@ -369,6 +384,9 @@ class EngineFleet:
                 pass
         self._respawn_tasks.clear()
         self._supervisor_task = None
+
+    async def stop(self) -> None:
+        await self.stop_supervisor()
         for rep in self.replicas:
             await rep.scheduler.stop()
 

@@ -87,9 +87,17 @@ class KafkaConfig:
     # redelivers it to the group instead of silently losing it. The app
     # pairs this with an in-memory per-message_id dedupe ring so
     # SAME-PROCESS redelivery (rebalance, producer retry) doesn't
-    # double-answer; redelivery after a full crash may re-answer — the
-    # standard at-least-once trade (serve/app.py).
+    # double-answer; pair with journal.path (JournalConfig) to close the
+    # crash-redelivery window too — the answered-id journal replays into
+    # the ring at restart (serve/app.py; ROBUSTNESS.md §5).
     commit_after_process: bool = False
+    # memory-broker committed offsets persist to this directory (defaults
+    # to journal.path when that is set), so a restart drill that stands up
+    # a fresh broker rewinds to the committed watermark exactly like a
+    # real consumer group; "" with no journal = in-memory only. The
+    # confluent backend ignores this (the real broker is durable).
+    # Also FINCHAT_KAFKA_OFFSETS_DIR.
+    offsets_dir: str = ""
 
     def librdkafka_config(self) -> dict[str, str]:
         """Render the confluent-kafka config dict, including the SASL_SSL ↔
@@ -218,6 +226,16 @@ class EngineConfig:
     # host-RAM byte budget for session KV snapshots (LRU-evicted beyond
     # it); 0 disables the tier even when session_cache is true
     session_cache_bytes: int = 256 << 20
+    # session disk spill tier (ISSUE 7; ROBUSTNESS.md §5): directory for
+    # checksummed session-KV record files. Entries WRITE THROUGH at put
+    # (atomic write-rename), RAM misses fall back to disk at admission,
+    # and a restarted process sweeps the directory and resumes
+    # conversations warm — a process kill costs at most the mid-stream
+    # turn. "" = host-RAM only. Also FINCHAT_SESSION_CACHE_DISK.
+    session_cache_disk_path: str = ""
+    # byte budget for the disk tier's own LRU (records evicted beyond it);
+    # also FINCHAT_SESSION_CACHE_DISK_BYTES
+    session_cache_disk_bytes: int = 4 << 30
     # int8 paged-KV cache (kv_cache.py): halves decode-side KV HBM traffic
     # and cache footprint via per-token-per-head scales; "" = model dtype.
     # Composes with a mesh: scales shard over their head row dim when
@@ -363,6 +381,35 @@ class FleetConfig:
 
 
 @dataclass
+class JournalConfig:
+    """Answered-message journal (io/journal.py — ISSUE 7; ROBUSTNESS.md §5).
+
+    With ``path`` set, every ANSWERED ``message_id`` is appended to a
+    checksummed journal and fsynced BEFORE its Kafka offset commits, and a
+    restarted process replays the journal into the fleet-wide dedupe ring —
+    closing the crash-redelivery double-answer window the in-memory ring
+    alone leaves open. Failed/shed/timed-out ids are never journaled, so
+    producer retries are reprocessed.
+    """
+
+    path: str = ""  # journal directory; "" = journal off. FINCHAT_JOURNAL_PATH
+    # fsync each append before returning (the ordering guarantee relies on
+    # it; turn off only for drills where torn tails are acceptable)
+    fsync: bool = True  # FINCHAT_JOURNAL_FSYNC
+
+
+@dataclass
+class ShutdownConfig:
+    """Graceful SIGTERM drain (serve/app.py drain_and_stop — ISSUE 7)."""
+
+    # how long in-flight streams may keep running after SIGTERM before the
+    # stragglers are preempted to host (session bytes spilled, stream
+    # failed with a retryable ``shutting_down`` error); also
+    # FINCHAT_SHUTDOWN_DEADLINE_SECONDS
+    deadline_seconds: float = 20.0
+
+
+@dataclass
 class ServeConfig:
     host: str = "0.0.0.0"
     port: int = 8000
@@ -378,6 +425,8 @@ class AppConfig:
     engine: EngineConfig = field(default_factory=EngineConfig)
     embed: EmbedConfig = field(default_factory=EmbedConfig)
     fleet: FleetConfig = field(default_factory=FleetConfig)
+    journal: JournalConfig = field(default_factory=JournalConfig)
+    shutdown: ShutdownConfig = field(default_factory=ShutdownConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
 
     def to_dict(self) -> dict[str, Any]:
@@ -459,6 +508,18 @@ def load_config(
     cfg.engine.session_cache_bytes = _env_int(
         "FINCHAT_SESSION_CACHE_BYTES", cfg.engine.session_cache_bytes
     )
+    cfg.engine.session_cache_disk_path = _env(
+        "FINCHAT_SESSION_CACHE_DISK", cfg.engine.session_cache_disk_path
+    )
+    cfg.engine.session_cache_disk_bytes = _env_int(
+        "FINCHAT_SESSION_CACHE_DISK_BYTES", cfg.engine.session_cache_disk_bytes
+    )
+    cfg.journal.path = _env("FINCHAT_JOURNAL_PATH", cfg.journal.path)
+    cfg.journal.fsync = _env_bool("FINCHAT_JOURNAL_FSYNC", cfg.journal.fsync)
+    cfg.shutdown.deadline_seconds = _env_float(
+        "FINCHAT_SHUTDOWN_DEADLINE_SECONDS", cfg.shutdown.deadline_seconds
+    )
+    cfg.kafka.offsets_dir = _env("FINCHAT_KAFKA_OFFSETS_DIR", cfg.kafka.offsets_dir)
     cfg.engine.retrieval_overlap = _env_bool(
         "FINCHAT_RETRIEVAL_OVERLAP", cfg.engine.retrieval_overlap
     )
@@ -494,5 +555,11 @@ def load_config(
     # --- explicit overrides win ---
     if overrides:
         _apply_overrides(cfg, overrides)
+
+    # memory-broker committed offsets default into the journal dir (one
+    # durability directory; ISSUE 7 satellite) — after overrides, so a
+    # CLI/file journal path carries the default along
+    if not cfg.kafka.offsets_dir and cfg.journal.path:
+        cfg.kafka.offsets_dir = cfg.journal.path
 
     return cfg

@@ -12,6 +12,12 @@ that raise, delay, or drop to drive the degradation contracts:
   QoS, kafka_client.py:26-27), error chunks are flushed;
 - retrieval failure: the answer is still generated with the Error marker
   (llm_agent.py:129-131).
+- durability plane (ISSUE 7): ``disk.spill`` (a failed session-record
+  write never fails the retiring stream), ``disk.restore`` (a failed /
+  corrupt record read quarantines the file and cold-starts the
+  conversation — never a crash, never stale KV), and ``journal.append``
+  (a failed answered-id append logs and continues — the cost is one
+  possible duplicate answer after a crash, the pre-journal trade).
 
 Sites are plain strings; ``ctx`` carries site-specific identifiers (e.g.
 ``seq_id``) so a handler can target one victim.

@@ -72,6 +72,24 @@ head had no live twin on the adopter — entry dropped, cold resume),
 replica), and ``finchat_fleet_reroutes_total`` (messages routed away
 from their affinity replica while it was out).
 
+Durability family (ISSUE 7 — session disk tier, answered-message journal,
+graceful drain; per replica like the per-engine families, since the disk
+tier observes through its cache's labeled view):
+``finchat_durability_spills_total`` / ``finchat_durability_spilled_bytes_
+total`` (session records written through to disk) and
+``finchat_durability_spill_failures_total``,
+``finchat_durability_disk_resident_bytes`` / ``finchat_durability_disk_
+entries`` (gauges — record-file tier occupancy),
+``finchat_durability_disk_evictions_total`` (disk-tier LRU),
+``finchat_durability_disk_restores_total`` + the
+``finchat_durability_restore_seconds`` histogram (RAM-miss fall-through
+loads), ``finchat_durability_quarantines_total`` (corrupt/truncated
+records renamed aside — cold start, never a crash),
+``finchat_durability_journal_appends_total`` / ``_journal_replayed_total``
+/ ``_journal_append_failures_total`` (answered-id journal), and the
+process-level ``finchat_durability_graceful_drains_total`` +
+``finchat_durability_shutdown_drain_seconds`` histogram (SIGTERM drain).
+
 Retrieval-plane family (embed/batcher.py microbatcher, embed/index.py
 batched search, agent/scheduler overlap):
 ``finchat_embed_batch_occupancy`` (gauge — texts in the last coalesced

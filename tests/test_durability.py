@@ -1,0 +1,633 @@
+"""Durable serving state (ISSUE 7; ROBUSTNESS.md §5).
+
+Contracts pinned here:
+
+- disk spill tier: record-file round trips are BYTE-IDENTICAL to the RAM
+  tier (token ids and every snapshot array), a restarted scheduler resumes
+  a conversation from disk with the same greedy output and resume depth as
+  the RAM tier would give, the tier's own LRU honors its byte budget, and
+  the startup sweep deletes write orphans and quarantines bad records;
+- fault sites (``disk.spill`` / ``disk.restore`` / ``journal.append``):
+  a corrupt, truncated, or fault-injected record is quarantined and the
+  conversation cold-starts — never a crash, never stale KV — and a failed
+  spill or journal append never fails the serving path;
+- answered-message journal: answered ids replay into the dedupe ring at
+  restart (redelivered answered message refused), failed ids are never
+  journaled (producer retry reprocessed), corrupt/torn records are
+  skipped without losing the intact ones;
+- memory-broker offset persistence: a fresh broker with the same offsets
+  dir rewinds to the committed watermark (only uncommitted records
+  redeliver), clamping with a warning when the fresh log is shorter;
+- graceful shutdown drain: in-flight streams complete (or stragglers fail
+  with a retryable ``shutting_down`` error), session bytes spill to disk,
+  and the scheduler exits with zero slot/page leaks.
+"""
+
+import asyncio
+import dataclasses
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from finchat_tpu.engine.engine import InferenceEngine
+from finchat_tpu.engine.sampler import SamplingParams
+from finchat_tpu.engine.scheduler import ContinuousBatchingScheduler
+from finchat_tpu.engine.session_cache import SessionDiskTier
+from finchat_tpu.io.journal import AnsweredJournal
+from finchat_tpu.io.kafka import InMemoryBroker, KafkaClient, Message
+from finchat_tpu.models.llama import PRESETS, init_params
+from finchat_tpu.utils import faults
+from finchat_tpu.utils.config import (
+    AI_RESPONSE_TOPIC,
+    USER_MESSAGE_TOPIC,
+    EngineConfig,
+    load_config,
+)
+from finchat_tpu.utils.metrics import METRICS
+
+CONFIG = dataclasses.replace(PRESETS["tiny"], dtype=jnp.float32)
+PAGE = 8
+CHUNK = 16
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faults.disarm_all()
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CONFIG, jax.random.key(0))
+
+
+def _make_scheduler(params, disk_path=None, disk_bytes=64 << 20,
+                    session_bytes=32 << 20):
+    cfg = EngineConfig(
+        max_seqs=4, page_size=PAGE, num_pages=128, max_seq_len=256,
+        prefill_chunk=CHUNK, session_cache=True,
+        session_cache_bytes=session_bytes,
+        session_cache_disk_path=str(disk_path) if disk_path else "",
+        session_cache_disk_bytes=disk_bytes,
+    )
+    return ContinuousBatchingScheduler(
+        InferenceEngine(CONFIG, params, cfg), eos_id=-1
+    )
+
+
+async def _collect(scheduler, seq_id, prompt_ids, n_new, conversation_id=None):
+    handle = await scheduler.submit(
+        seq_id, prompt_ids,
+        SamplingParams(temperature=0.0, max_new_tokens=n_new),
+        conversation_id=conversation_id,
+    )
+    tokens = []
+    while True:
+        event = await asyncio.wait_for(handle.events.get(), timeout=120)
+        if event["type"] == "token":
+            tokens.append(event["token_id"])
+        elif event["type"] == "done":
+            return handle, tokens
+        else:
+            return handle, event
+
+
+# --- disk tier: record format, byte identity, LRU, sweep ------------------
+
+def test_disk_record_roundtrip_byte_identity(tmp_path):
+    tier = SessionDiskTier(str(tmp_path), 1 << 20)
+    tok = np.arange(24, dtype=np.int32)
+    snap = (
+        np.arange(96, dtype=np.float32).reshape(2, 3, 16),
+        np.full((2, 3, 16), 7.5, np.float32),
+        None, None,  # bf16/int8-less cache: no scale planes
+    )
+    assert tier.spill("c1#resp", tok, 8, snap)
+    payload = tier.load("c1#resp")
+    assert np.array_equal(payload["token_ids"], tok)
+    assert payload["token_ids"].dtype == np.int32
+    assert payload["prefix_len"] == 8
+    assert payload["snap"][0].tobytes() == snap[0].tobytes()
+    assert payload["snap"][1].tobytes() == snap[1].tobytes()
+    assert payload["snap"][2] is None and payload["snap"][3] is None
+    # a None snap (prefix-only entry) round-trips too
+    assert tier.spill("c2#resp", tok[:8], 8, None)
+    p2 = tier.load("c2#resp")
+    assert p2["snap"] is None and np.array_equal(p2["token_ids"], tok[:8])
+
+
+def test_disk_tier_lru_budget(tmp_path):
+    tier = SessionDiskTier(str(tmp_path), budget_bytes=1 << 20)
+    snap = (np.zeros((2, 4, 64), np.float32), np.zeros((2, 4, 64), np.float32),
+            None, None)  # ~4 KiB per record
+    record_size = len(SessionDiskTier._serialize("k", np.arange(8, dtype=np.int32), 0, snap))
+    tier.budget_bytes = int(2.5 * record_size)
+    for i in range(4):
+        assert tier.spill(f"conv{i}", np.arange(8, dtype=np.int32), 0, snap)
+    tier.flush()  # write-behind: evictions land on the writer thread
+    # budget holds ~2.5 records: the two oldest evicted
+    assert len(tier) == 2
+    assert tier.resident_bytes <= tier.budget_bytes
+    assert "conv0" not in tier and "conv1" not in tier
+    assert tier.load("conv3") is not None
+    # a loaded (LRU-refreshed) record survives the next spill's eviction
+    tier.load("conv2")
+    tier.spill("conv4", np.arange(8, dtype=np.int32), 0, snap)
+    tier.flush()
+    assert "conv2" in tier
+
+
+def test_disk_tier_startup_sweep_orphans_and_corruption(tmp_path):
+    tier = SessionDiskTier(str(tmp_path), 1 << 20)
+    snap = (np.ones((2, 2, 8), np.float32), np.ones((2, 2, 8), np.float32),
+            None, None)
+    tier.spill("good", np.arange(16, dtype=np.int32), 0, snap)
+    tier.spill("truncated", np.arange(16, dtype=np.int32), 0, snap)
+    tier.flush()  # both records must be on disk before we tamper/sweep
+    # crash leftovers: a partial .tmp write and a truncated record
+    (tmp_path / "deadbeef.skv.tmp").write_bytes(b"partial")
+    trunc = tmp_path / SessionDiskTier._fname("truncated")
+    trunc.write_bytes(trunc.read_bytes()[:-7])
+    swept = SessionDiskTier(str(tmp_path), 1 << 20)
+    assert "good" in swept and len(swept) == 1
+    assert not list(tmp_path.glob("*.tmp"))
+    assert list(tmp_path.glob("*.quarantine"))
+    assert swept.load("good") is not None
+    assert swept.load("truncated") is None
+
+
+# --- crash-restart resume: byte identity vs the RAM tier ------------------
+
+def test_spill_restore_byte_identity_vs_ram_tier(tmp_path, params):
+    """A restarted scheduler (fresh RAM tier, same disk dir) must resume a
+    conversation exactly as deep as the RAM tier would have, with
+    byte-identical greedy output."""
+    t1 = list(range(1, 14))
+
+    async def run():
+        sched = _make_scheduler(params, tmp_path / "disk")
+        await sched.start()
+        _, toks1 = await _collect(sched, "a-t1", t1, 8, conversation_id="convA")
+        t2 = t1 + toks1 + [7, 8, 9]
+        h_ram, toks2_ram = await _collect(sched, "a-t2", t2, 8,
+                                          conversation_id="convA")
+        await sched.stop()
+        sched.session_cache.disk.flush()  # a real crash-to-restart gap
+        # "crash": new scheduler, same disk dir — the RAM tier is gone
+        sched2 = _make_scheduler(params, tmp_path / "disk")
+        assert sched2.session_cache.get("convA") is None
+        await sched2.start()
+        h_disk, toks2_disk = await _collect(sched2, "b-t2", t2, 8,
+                                            conversation_id="convA")
+        await sched2.stop()
+        assert h_disk.resumed_len == h_ram.resumed_len > 0
+        assert toks2_disk == toks2_ram
+        sched2.allocator.check_invariants()
+
+    asyncio.run(run())
+
+
+def test_corrupt_record_quarantined_cold_start(tmp_path, params):
+    """A bit-flipped record is quarantined at restore time: the stream
+    COLD-starts (no stale KV, no crash) and still produces the same greedy
+    output."""
+    t1 = list(range(1, 14))
+
+    async def run():
+        sched = _make_scheduler(params, tmp_path / "disk")
+        await sched.start()
+        _, toks1 = await _collect(sched, "a-t1", t1, 8, conversation_id="convB")
+        await sched.stop()
+        sched.session_cache.disk.flush()
+        t2 = t1 + toks1 + [7, 8, 9]
+        # corrupt the record's payload
+        f = (tmp_path / "disk") / SessionDiskTier._fname("convB")
+        raw = bytearray(f.read_bytes())
+        raw[-1] ^= 0xFF
+        f.write_bytes(bytes(raw))
+        q0 = METRICS.get("finchat_durability_quarantines_total")
+        sched2 = _make_scheduler(params, tmp_path / "disk")
+        await sched2.start()
+        h, toks2 = await _collect(sched2, "b-t2", t2, 8, conversation_id="convB")
+        await sched2.stop()
+        assert h.resumed_len == 0  # cold start, not stale KV
+        assert METRICS.get("finchat_durability_quarantines_total") == q0 + 1
+        assert list((tmp_path / "disk").glob("*.quarantine"))
+        # cold output is the golden output (session cache on/off identity)
+        sched3 = _make_scheduler(params, None)
+        await sched3.start()
+        _, toks_cold = await _collect(sched3, "c-t2", t2, 8)
+        await sched3.stop()
+        assert toks2 == toks_cold
+
+    asyncio.run(run())
+
+
+def test_queued_spill_is_visible_before_it_lands(tmp_path):
+    """Membership must see QUEUED writes, not only landed records: a
+    just-spilled, RAM-evicted entry would otherwise read as absent at the
+    restore gate and cold-start — the warm-resume feature silently failing
+    exactly in the busy-disk window. And ``load`` must barrier on a queued
+    write (or discard) of ITS key — but only its key's, not the whole
+    queue."""
+    import threading as _threading
+
+    tier = SessionDiskTier(str(tmp_path), 1 << 20)
+    gate = _threading.Event()
+    tier._writer.submit(gate.wait)  # wedge the writer: writes stay queued
+    snap = (np.ones((2, 2, 8), np.float32), np.ones((2, 2, 8), np.float32),
+            None, None)
+    try:
+        tier.spill("convQ", np.arange(16, dtype=np.int32), 0, snap)
+        assert "convQ" in tier          # queued, not yet landed
+        assert len(tier) == 0           # the index itself only holds landed
+        assert not list(tmp_path.glob("*.skv"))
+    finally:
+        gate.set()
+    payload = tier.load("convQ")        # barriers on the pending write
+    assert payload is not None and "convQ" in tier and len(tier) == 1
+    # a queued discard is pending-visible the same way; load observes it
+    wedge = _threading.Event()
+    tier._writer.submit(wedge.wait)
+    tier.discard("convQ")
+    wedge.set()
+    assert tier.load("convQ") is None
+    assert "convQ" not in tier
+
+
+def test_over_budget_record_trims_to_partial_warm_resume(tmp_path, params):
+    """A disk record bigger than the restarted process's RAM budget is
+    TRIMMED to the page-whole prefix that fits — a partial warm resume —
+    instead of being refused by ``put`` on every turn (full record read +
+    rewrite churn that never warms anything)."""
+    t1 = list(range(1, 14))
+
+    async def run():
+        sched = _make_scheduler(params, tmp_path / "disk")
+        await sched.start()
+        _, toks1 = await _collect(sched, "a-t1", t1, 8, conversation_id="convO")
+        await sched.stop()
+        sched.session_cache.disk.flush()
+        entry = sched.session_cache.get("convO")
+        own_pages = (entry.n_tokens - entry.prefix_len) // PAGE
+        assert own_pages >= 2
+        per_page = entry.nbytes // own_pages
+        t2 = t1 + toks1 + [7, 8, 9]
+        # restart with a RAM budget that fits only ONE of the record's pages
+        sched2 = _make_scheduler(params, tmp_path / "disk",
+                                 session_bytes=per_page + per_page // 2)
+        await sched2.start()
+        h, toks2 = await _collect(sched2, "b-t2", t2, 8,
+                                  conversation_id="convO")
+        await sched2.stop()
+        assert 0 < h.resumed_len <= PAGE  # trimmed: warm, just shallower
+        # trimming never changes the output (same identity contract as
+        # divergence truncation)
+        sched3 = _make_scheduler(params, None)
+        await sched3.start()
+        _, toks_cold = await _collect(sched3, "c-t2", t2, 8)
+        await sched3.stop()
+        assert toks2 == toks_cold
+        sched2.allocator.check_invariants()
+
+    asyncio.run(run())
+
+
+def test_restore_skips_redundant_respill(tmp_path, params):
+    """A disk restore must not rewrite the record it just read: the bytes
+    are already on disk, so a write-through from the restore path would
+    double every fall-through's I/O for nothing."""
+    t1 = list(range(1, 14))
+
+    async def run():
+        sched = _make_scheduler(params, tmp_path / "disk")
+        await sched.start()
+        await _collect(sched, "a-t1", t1, 8, conversation_id="convP")
+        await sched.stop()
+        sched.session_cache.disk.flush()
+        sched2 = _make_scheduler(params, tmp_path / "disk")
+        s0 = METRICS.get("finchat_durability_spills_total")
+        assert sched2._restore_session_from_disk("convP")
+        sched2.session_cache.disk.flush()
+        assert METRICS.get("finchat_durability_spills_total") == s0
+        assert sched2.session_cache.get("convP") is not None
+
+    asyncio.run(run())
+
+
+async def test_drain_stops_fleet_supervisor_before_scheduler_drain(tmp_path):
+    """The graceful drain must take the fleet supervisor down BEFORE the
+    per-replica shutdown drains: a respawn's device rebuild racing
+    ``shutdown_drain`` on the same engine could corrupt allocator/slot
+    state and defeat the zero-leak exit."""
+    app, _broker = _stub_app(tmp_path)
+    await app.start(serve_http=False)
+    order = []
+
+    class FakeSched:
+        async def shutdown_drain(self):
+            order.append("shutdown_drain")
+
+    class FakeRep:
+        scheduler = FakeSched()
+
+    class FakeFleet:
+        replicas = [FakeRep()]
+
+        async def stop_supervisor(self):
+            order.append("stop_supervisor")
+
+        async def stop(self):
+            order.append("fleet_stop")
+
+    app.fleet = FakeFleet()
+    await app.drain_and_stop()
+    assert order == ["stop_supervisor", "shutdown_drain", "fleet_stop"]
+
+
+# --- fault sites (ISSUE 7 satellite) --------------------------------------
+
+def test_disk_spill_fault_never_fails_stream(tmp_path, params):
+    async def run():
+        sched = _make_scheduler(params, tmp_path / "disk")
+        faults.arm("disk.spill", faults.one_shot(RuntimeError("disk full")))
+        f0 = METRICS.get("finchat_durability_spill_failures_total")
+        await sched.start()
+        h, toks = await _collect(sched, "s1", list(range(1, 14)), 8,
+                                 conversation_id="convF")
+        await sched.stop()
+        sched.session_cache.disk.flush()  # the failure lands off-loop
+        assert len(toks) == 8  # the stream retired normally
+        assert METRICS.get("finchat_durability_spill_failures_total") == f0 + 1
+        assert "convF" not in sched.session_cache.disk
+        # the RAM entry is still there — only the durability write failed
+        assert sched.session_cache.get("convF") is not None
+
+    asyncio.run(run())
+
+
+def test_disk_restore_fault_quarantines_and_cold_starts(tmp_path, params):
+    t1 = list(range(1, 14))
+
+    async def run():
+        sched = _make_scheduler(params, tmp_path / "disk")
+        await sched.start()
+        _, toks1 = await _collect(sched, "a-t1", t1, 8, conversation_id="convR")
+        await sched.stop()
+        sched.session_cache.disk.flush()
+        sched2 = _make_scheduler(params, tmp_path / "disk")
+        assert "convR" in sched2.session_cache.disk
+        faults.arm("disk.restore", faults.one_shot(RuntimeError("read error")))
+        q0 = METRICS.get("finchat_durability_quarantines_total")
+        await sched2.start()
+        h, toks2 = await _collect(sched2, "b-t2", t1 + toks1 + [7, 8, 9], 8,
+                                  conversation_id="convR")
+        await sched2.stop()
+        assert len(toks2) == 8 and h.resumed_len == 0  # cold, never stale
+        # the unreadable record was quarantined; the cold turn's own
+        # retirement then write-through-spilled a FRESH record
+        assert METRICS.get("finchat_durability_quarantines_total") == q0 + 1
+        assert list((tmp_path / "disk").glob("*.quarantine"))
+
+    asyncio.run(run())
+
+
+def test_journal_append_fault_logs_and_continues(tmp_path):
+    journal = AnsweredJournal(str(tmp_path))
+    faults.arm("journal.append", faults.one_shot(RuntimeError("disk full")))
+    f0 = METRICS.get("finchat_durability_journal_append_failures_total")
+    assert journal.append("m1") is False
+    assert METRICS.get("finchat_durability_journal_append_failures_total") == f0 + 1
+    assert journal.append("m2") is True
+    assert AnsweredJournal(str(tmp_path)).replay() == ["m2"]
+
+
+# --- answered-message journal ---------------------------------------------
+
+def test_journal_replay_compacts_and_skips_corrupt_records(tmp_path):
+    journal = AnsweredJournal(str(tmp_path), keep=3)
+    for mid in ("m1", "m2", "m3", "m1", 42):
+        journal.append(mid)
+    journal.close()
+    # torn tail (crash mid-append) + a corrupt middle record
+    with open(journal.path, "r+b") as f:
+        raw = f.read()
+        lines = raw.split(b"\n")
+        lines[1] = b"v1 00000000 " + lines[1].split(b" ", 2)[2]  # bad crc
+        f.seek(0)
+        f.write(b"\n".join(lines) + b"v1 deadbe")  # torn final line
+        f.truncate()
+    replayed = AnsweredJournal(str(tmp_path), keep=3).replay()
+    # m2 corrupted away; keep=3 most recent distinct of [m1, m3, m1, 42]
+    assert replayed == ["m3", "m1", 42]
+    # the compacted file replays identically (idempotent)
+    assert AnsweredJournal(str(tmp_path), keep=3).replay() == ["m3", "m1", 42]
+
+
+def _stub_app(tmp_path, broker=None, fail=False):
+    from finchat_tpu.engine.generator import StubGenerator
+    from finchat_tpu.io.store import InMemoryStore
+    from finchat_tpu.serve.app import build_app
+
+    cfg = load_config(overrides={"model.preset": "stub"})
+    cfg.kafka.commit_after_process = True
+    cfg.journal.path = str(tmp_path / "journal")
+    broker = broker or InMemoryBroker()
+    store = InMemoryStore()
+    store.upsert_context("c1", {"user_id": "u9", "name": "Alex",
+                                "income": 5000, "savings_goal": 800})
+    store.add_user_message("c1", "How am I doing?", "u9")
+    app = build_app(
+        cfg, store=store, kafka=KafkaClient(cfg.kafka, broker=broker),
+        tool_generator=StubGenerator(default="No tool call"),
+        response_generator=StubGenerator(
+            default="You are doing fine.",
+            fail_with="boom" if fail else None,
+        ),
+    )
+    return app, broker
+
+
+def _kafka_msg(payload, offset=0):
+    return Message(USER_MESSAGE_TOPIC, payload["conversation_id"],
+                   json.dumps(payload).encode(), offset=offset, partition=0)
+
+
+async def test_answered_id_journaled_before_commit_and_replayed(tmp_path):
+    """The fsync-before-commit ordering end-to-end: an ANSWERED message's
+    id is on disk by the time its offset commits, a restarted app replays
+    it into the dedupe ring, and the redelivered message is skipped —
+    zero double answers across a crash."""
+    app, broker = _stub_app(tmp_path)
+    committed = []
+    app.kafka.commit_offset = (
+        lambda t, p, n: committed.append(
+            (tmp_path / "journal" / "answered.journal").read_bytes()
+        )
+    )
+    payload = {"message": "How am I doing?", "conversation_id": "c1",
+               "user_id": "u9", "message_id": "mid-1"}
+    msg = _kafka_msg(payload)
+    app._note_message_polled(msg)
+    app._spawn_message_task(msg)
+    await asyncio.gather(*app._inflight)
+    await asyncio.sleep(0)  # let the done-callback run
+    # the journal bytes the commit observed already contained the id
+    assert committed and b"mid-1" in committed[0]
+    # restart: fresh ring, same journal — the id replays in
+    app2, broker2 = _stub_app(tmp_path, broker=InMemoryBroker())
+    assert "mid-1" in app2._seen_ids
+    skips0 = METRICS.get("finchat_kafka_dedupe_skips_total")
+    app2._spawn_message_task(_kafka_msg(payload))
+    assert not app2._inflight  # redelivery refused, not reprocessed
+    assert METRICS.get("finchat_kafka_dedupe_skips_total") == skips0 + 1
+    assert [json.loads(m.value().decode())
+            for m in broker2.drain(AI_RESPONSE_TOPIC)] == []
+
+
+async def test_failed_id_never_journaled(tmp_path):
+    """A FAILED message leaves no journal record: the restarted process
+    reprocesses the producer's retry instead of black-holing it."""
+    app, _broker = _stub_app(tmp_path, fail=True)
+    payload = {"message": "How am I doing?", "conversation_id": "c1",
+               "user_id": "u9", "message_id": "mid-f"}
+    msg = _kafka_msg(payload)
+    app._note_message_polled(msg)
+    app._spawn_message_task(msg)
+    await asyncio.gather(*app._inflight)
+    await asyncio.sleep(0)
+    app2, _b2 = _stub_app(tmp_path)
+    assert "mid-f" not in app2._seen_ids
+
+
+# --- memory-broker committed-offset persistence ---------------------------
+
+def test_broker_offsets_persist_and_rewind(tmp_path):
+    d = str(tmp_path)
+    b1 = InMemoryBroker(offsets_dir=d)
+    part = b1._partition_for("k")
+    for i in range(3):
+        b1.produce("t", "k", b"%d" % i)
+    b1.join_group("g", "m1", ["t"], "earliest")
+    for _ in range(3):
+        assert b1.poll("g", "m1", ["t"], auto_commit=False) is not None
+    b1.commit("g", "t", part, 2)  # first two processed; third uncommitted
+    # "restart": fresh broker, same records re-produced, same offsets dir —
+    # the group rewinds to the committed watermark, redelivering ONLY the
+    # uncommitted tail
+    b2 = InMemoryBroker(offsets_dir=d)
+    for i in range(3):
+        b2.produce("t", "k", b"%d" % i)
+    b2.join_group("g", "m2", ["t"], "earliest")
+    redelivered = []
+    while True:
+        m = b2.poll("g", "m2", ["t"], auto_commit=False)
+        if m is None:
+            break
+        redelivered.append(m.offset())
+    assert redelivered == [2]
+
+
+def test_broker_persisted_offset_beyond_log_clamps(tmp_path, caplog):
+    d = str(tmp_path)
+    b1 = InMemoryBroker(offsets_dir=d)
+    part = b1._partition_for("k")
+    for i in range(3):
+        b1.produce("t", "k", b"%d" % i)
+    b1.join_group("g", "m1", ["t"], "earliest")
+    b1.commit("g", "t", part, 3)
+    # fresh broker holds FEWER records than the committed watermark
+    b3 = InMemoryBroker(offsets_dir=d)
+    b3.produce("t", "k", b"0")
+    with caplog.at_level("WARNING"):
+        b3.join_group("g", "m3", ["t"], "earliest")
+    assert any("beyond the log" in r.message for r in caplog.records)
+    assert b3.poll("g", "m3", ["t"], auto_commit=False) is None  # clamped
+
+
+# --- graceful shutdown drain ----------------------------------------------
+
+def test_shutdown_drain_straggler_zero_leaks_and_spill(tmp_path, params):
+    """SIGTERM with a stream mid-decode: the straggler is preempted to
+    host with a retryable ``shutting_down`` error, its coherent KV spills
+    through the session tier to disk, and the scheduler exits with zero
+    slot/page leaks."""
+
+    async def run():
+        sched = _make_scheduler(params, tmp_path / "disk")
+        await sched.start()
+        h = await sched.submit(
+            "s1", list(range(1, 14)),
+            SamplingParams(temperature=0.0, max_new_tokens=100),
+            conversation_id="convS",
+        )
+        pending = await sched.submit(
+            "s2", list(range(30, 44)),
+            SamplingParams(temperature=0.0, max_new_tokens=100),
+        )
+        faults.arm("scheduler.decode", lambda **_: time.sleep(0.01))
+        while h.generated < 3:
+            await asyncio.sleep(0.005)
+        await sched.shutdown_drain()
+        events = []
+        while not h.events.empty():
+            events.append(h.events.get_nowait())
+        err = [e for e in events if e["type"] == "error"]
+        assert err and err[-1]["code"] == "shutting_down"
+        assert err[-1]["retryable"] is True
+        p_events = []
+        while not pending.events.empty():
+            p_events.append(pending.events.get_nowait())
+        assert any(e.get("code") == "shutting_down" for e in p_events)
+        # zero slot/page leaks
+        assert sched.allocator.used_count == 0
+        assert len(sched.free_slots) == 4
+        assert not sched.decoding and not sched.prefilling and not sched.pending
+        sched.allocator.check_invariants()
+        # the straggler's coherent prompt+generated KV reached the disk tier
+        assert "convS" in sched.session_cache.disk
+        payload = sched.session_cache.disk.load("convS")
+        n_coherent = ((13 + h.generated - 1) // PAGE) * PAGE
+        assert payload["token_ids"].shape[0] == n_coherent
+
+    asyncio.run(run())
+
+
+async def test_app_drain_completes_inflight_within_deadline(tmp_path):
+    """App-level graceful drain: an in-flight message COMPLETES (its
+    answer and complete marker go out) before drain_and_stop returns, and
+    new HTTP admission is refused with a retryable 503."""
+    from finchat_tpu.serve.http import Request
+
+    app, broker = _stub_app(tmp_path)
+    app.cfg.shutdown.deadline_seconds = 30.0
+    app.agent.response_generator.chunk_delay = 0.02
+    await app.start(serve_http=False)
+    producer = KafkaClient(app.cfg.kafka, broker=broker)
+    producer.produce_message(
+        USER_MESSAGE_TOPIC, "c1",
+        {"message": "How am I doing?", "conversation_id": "c1",
+         "user_id": "u9", "message_id": "mid-d"},
+    )
+    for _ in range(500):
+        out = [json.loads(m.value().decode())
+               for m in broker.drain(AI_RESPONSE_TOPIC)]
+        if out:
+            break
+        await asyncio.sleep(0.01)
+    assert out, "stream never started"
+    d0 = METRICS.get("finchat_durability_graceful_drains_total")
+    await app.drain_and_stop()
+    assert METRICS.get("finchat_durability_graceful_drains_total") == d0 + 1
+    out = [json.loads(m.value().decode())
+           for m in broker.drain(AI_RESPONSE_TOPIC)]
+    assert any(c.get("type") == "complete" for c in out)
+    # admission is closed while draining
+    app._draining = True
+    resp = app._payload_error({"conversation_id": "c1", "message": "x",
+                               "user_id": "u9"})
+    assert resp is not None and resp.status == 503
