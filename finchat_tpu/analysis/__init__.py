@@ -1,0 +1,69 @@
+"""finchat-lint: AST-based serving-plane discipline checker (ISSUE 8).
+
+PRs 4-7 caught the same three bug classes by hand on every review round:
+seconds-class blocking work on the asyncio scheduler loop (inline device
+rebuilds, serialize+fsync spills), host-sync calls sneaking into the
+one-dispatch-per-iteration hot path, and slot/page/ref leaks on cleanup
+paths that each needed a bespoke regression test. Those invariants are
+load-bearing across ~10 modules but lived only in reviewers' heads; this
+package machine-checks them on every push.
+
+Rule catalog (see STATIC_ANALYSIS.md for the full contract):
+
+- R1 ``event-loop-blocking`` — blocking primitives (fsync, time.sleep,
+  ``block_until_ready``, device-rebuild entry points, executor joins,
+  blocking file opens) reachable from ``async def`` bodies or registered
+  loop callbacks, via a package-wide call graph. Off-loop seams
+  (``asyncio.to_thread``, ``run_in_executor``, executor ``submit``,
+  threads) prune the walk.
+- R2 ``hot-path-host-sync`` — ``.item()`` / ``np.asarray`` / ``float()``
+  / implicit ``__bool__`` on device values inside hot scopes (``ops/``,
+  ``engine/engine.py``, the scheduler dispatch/consume paths), protecting
+  the dispatches-per-iteration contract of PR 4 / ROADMAP item 1.
+- R3 ``resource-pairing`` — allocator acquires / slot claims /
+  ``refs += 1`` must release or escape on every exit path, and cleanup
+  paths must not run unguarded device ops ahead of their releases (the
+  ``_fail_prefix_job`` bug class PR 6 fixed).
+- R4 ``knob-consistency`` — every ``utils/config.py`` knob's env var,
+  CLI flag, and README mention must agree (drift check).
+- R5 ``metrics-discipline`` — ``finchat_`` naming, counter/gauge/
+  histogram suffix conventions, and the PR 6 labeled-vs-unlabeled family
+  convention (fleet-level series emit unlabeled on the global registry).
+
+Usage::
+
+    python -m finchat_tpu.analysis finchat_tpu/ tests/
+    python -m finchat_tpu.analysis --list-rules
+    python -m finchat_tpu.analysis --update-baseline
+
+Inline suppressions: ``# finchat-lint: disable=<rule>[,<rule>] -- why``
+on the offending line, or on a ``def``/``class`` line to cover the scope.
+The justification after ``--`` is mandatory (checked by the
+``suppression-discipline`` meta rule). The checked-in baseline
+(``LINT_BASELINE.json``) may only shrink: new findings fail the run.
+
+The package also ships the runtime complements (``sanitizers.py``): an
+asyncio stall sanitizer (instrumented loop that fails a test when any
+callback exceeds a threshold — the dynamic teeth behind R1) and a leak
+sanitizer (asserts allocator/slots/pages/session-cache refs/journal
+handles are clean after scheduler/fleet/durability tests — the dynamic
+teeth behind R3). ``tests/conftest.py`` wires both in.
+"""
+
+from finchat_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    ProjectIndex,
+    Rule,
+    load_baseline,
+    run_analysis,
+    write_baseline,
+)
+
+__all__ = [
+    "Finding",
+    "ProjectIndex",
+    "Rule",
+    "run_analysis",
+    "load_baseline",
+    "write_baseline",
+]

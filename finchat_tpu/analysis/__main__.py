@@ -1,0 +1,149 @@
+"""CLI: ``python -m finchat_tpu.analysis [paths...]``.
+
+Exit codes: 0 = clean (every finding suppressed or baselined), 1 = new
+unsuppressed findings (or a missing-justification suppression), 2 = usage
+error. The baseline (``LINT_BASELINE.json`` at the repo root) may only
+shrink: ``--update-baseline`` rewrites it from the current findings and
+is the ONLY sanctioned way to change it (reviewers diff it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from finchat_tpu.analysis.core import (
+    Finding,
+    _collect_py_files,
+    default_rules,
+    load_baseline,
+    run_analysis,
+    write_baseline,
+)
+
+DEFAULT_PATHS = ["finchat_tpu"]
+BASELINE_NAME = "LINT_BASELINE.json"
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m finchat_tpu.analysis",
+        description="finchat-lint: serving-plane discipline checker "
+        "(rules R1-R5; see STATIC_ANALYSIS.md)",
+    )
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files/dirs to analyze (default: finchat_tpu)")
+    p.add_argument("--root", default=".",
+                   help="repo root (baseline + README live here)")
+    p.add_argument("--baseline", default=None,
+                   help=f"baseline file (default: <root>/{BASELINE_NAME})")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline from the current findings")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline (report everything)")
+    p.add_argument("--rule", action="append", default=None,
+                   help="run only this rule (name or R-code; repeatable)")
+    p.add_argument("--list-rules", action="store_true")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--show-suppressed", action="store_true",
+                   help="also list suppressed findings")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for rule in default_rules():
+            print(f"{rule.code}  {rule.name:<24} {rule.description}")
+        print("--  suppression-discipline   "
+              "every `# finchat-lint: disable=` carries a `-- why`")
+        return 0
+
+    root = Path(args.root)
+    paths = [Path(x) for x in (args.paths or DEFAULT_PATHS)]
+    for path in paths:
+        if not path.exists():
+            print(f"error: no such path: {path}", file=sys.stderr)
+            return 2
+
+    result = run_analysis(root, paths, rule_filter=set(args.rule) if args.rule else None)
+
+    baseline_path = Path(args.baseline) if args.baseline else root / BASELINE_NAME
+    baseline = {} if args.no_baseline else load_baseline(baseline_path)
+
+    new_findings = [f for f in result.findings if f.fingerprint() not in baseline]
+    baselined = [f for f in result.findings if f.fingerprint() in baseline]
+    stale = sorted(
+        set(baseline) - {f.fingerprint() for f in result.findings}
+    )
+
+    if args.update_baseline:
+        if args.rule:
+            # a rule-filtered run sees only a slice of the findings;
+            # regenerating from it would silently delete every other
+            # rule's entries and turn them into NEW findings on the next
+            # full run
+            print("error: --update-baseline cannot be combined with "
+                  "--rule (the baseline must be regenerated from a full "
+                  "rule run)", file=sys.stderr)
+            return 2
+        # entries for files OUTSIDE the analyzed set are preserved — a
+        # narrowed-path run must only update what it actually looked at
+        analyzed = set()
+        for f in _collect_py_files(paths):
+            try:  # mirror ProjectIndex._rel for paths outside the root
+                analyzed.add(f.resolve().relative_to(root.resolve()).as_posix())
+            except ValueError:
+                analyzed.add(f.as_posix())
+        keep = [
+            Finding(e["rule"], e["path"], 0, e["symbol"], e["message"])
+            for fp, e in load_baseline(baseline_path).items()
+            if e["path"] not in analyzed
+        ]
+        write_baseline(baseline_path, result.findings + keep)
+        print(f"baseline written: {baseline_path} "
+              f"({len(result.findings)} finding(s)"
+              + (f" + {len(keep)} kept for unanalyzed files" if keep else "")
+              + ")")
+        return 0
+
+    failing = new_findings + result.meta_findings
+
+    if args.format == "json":
+        print(json.dumps(
+            {
+                "findings": [f.__dict__ | {"fingerprint": f.fingerprint()}
+                             for f in new_findings],
+                "meta": [f.__dict__ for f in result.meta_findings],
+                "baselined": len(baselined),
+                "suppressed": len(result.suppressed),
+                "stale_baseline_entries": stale,
+            },
+            indent=2,
+        ))
+        return 1 if failing else 0
+
+    for f in new_findings:
+        print(f.render())
+    for f in result.meta_findings:
+        print(f.render())
+    if args.show_suppressed:
+        for f, sup in result.suppressed:
+            print(f"suppressed: {f.render()}")
+    for path, line in result.unused_suppressions:
+        print(f"note: {path}:{line}: unused suppression (safe to delete)")
+    if stale:
+        print(f"note: {len(stale)} stale baseline entr"
+              f"{'y' if len(stale) == 1 else 'ies'} — the finding is gone; "
+              "run --update-baseline to shrink the file")
+
+    n_sup = len(result.suppressed)
+    print(
+        f"finchat-lint: {len(new_findings)} new finding(s), "
+        f"{len(baselined)} baselined, {n_sup} suppressed, "
+        f"{len(result.meta_findings)} meta finding(s)"
+    )
+    return 1 if failing else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

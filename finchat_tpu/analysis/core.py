@@ -1,0 +1,779 @@
+"""finchat-lint framework: project index, rule registry, suppressions,
+baseline.
+
+The framework is deliberately self-contained (stdlib ``ast`` + ``tokenize``
+only — no third-party lint deps, per the image constraint) and builds ONE
+shared :class:`ProjectIndex` that every rule visitor reads:
+
+- modules → classes → functions (nested defs included, qualnames like
+  ``engine.scheduler.Scheduler._trip_breaker``),
+- per-module import maps (so ``sleep(...)`` after ``from time import
+  sleep`` still resolves to ``time.sleep``),
+- per-class attribute types inferred from ``self.x = ClassName(...)``
+  assignments and annotated ``__init__`` params (so ``self.engine.foo()``
+  resolves into ``InferenceEngine.foo``),
+- per-function call sites with off-loop boundaries already marked
+  (``asyncio.to_thread`` / ``run_in_executor`` / executor ``submit`` /
+  ``threading.Thread`` — a lambda handed to one of those runs OFF the
+  loop, while its sibling arguments still evaluate ON it),
+- loop-callback registrations (``add_done_callback`` / ``call_soon`` /
+  ...), which R1 treats as roots alongside ``async def`` bodies.
+
+Suppressions: ``# finchat-lint: disable=<rule>[,<rule>] -- <why>`` on the
+finding's line, or on a ``def``/``class`` line to cover that whole scope.
+The ``-- why`` justification is mandatory; a bare disable is itself
+reported by the ``suppression-discipline`` meta rule. ``# finchat-lint:
+hot`` on a ``def`` line opts a function into R2's hot set.
+
+Baseline: ``LINT_BASELINE.json`` maps finding fingerprints (stable across
+line drift — no line numbers inside) to their descriptions. The gate is
+one-directional: a finding not in the baseline fails the run; a baseline
+entry with no matching finding is stale and only ``--update-baseline``
+removes it. The file may only shrink.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import io
+import json
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+
+# ---------------------------------------------------------------------------
+# findings
+# ---------------------------------------------------------------------------
+
+_SUPPRESS_RE = re.compile(
+    r"finchat-lint:\s*(?P<kind>disable|hot)"
+    r"(?:\s*=\s*(?P<rules>[A-Za-z0-9_,\- ]+?))?"
+    r"\s*(?:--\s*(?P<why>.+?))?\s*$"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation. ``message`` must be stable (no line numbers, no
+    absolute paths) — the baseline fingerprints it."""
+
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    symbol: str  # enclosing qualname ("" for module-level findings)
+    message: str
+
+    def fingerprint(self) -> str:
+        raw = f"{self.rule}|{self.path}|{self.symbol}|{self.message}"
+        return hashlib.sha1(raw.encode()).hexdigest()[:16]
+
+    def render(self) -> str:
+        where = f"{self.path}:{self.line}"
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return f"{where}: {self.rule}{sym}: {self.message}"
+
+
+@dataclass
+class Suppression:
+    line: int
+    rules: tuple[str, ...]
+    justified: bool
+    used: bool = False
+
+
+# ---------------------------------------------------------------------------
+# project model
+# ---------------------------------------------------------------------------
+
+_OFF_LOOP_WRAPPERS = (
+    "to_thread",
+    "run_in_executor",
+    "submit",
+    "Thread",
+    "run_coroutine_threadsafe",
+)
+
+_CALLBACK_REGISTRARS = (
+    "add_done_callback",
+    "call_soon",
+    "call_soon_threadsafe",
+    "call_later",
+    "call_at",
+)
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@dataclass(eq=False)
+class CallSite:
+    node: ast.Call
+    dotted: str | None  # unresolved dotted form ("self.engine.reset_slot")
+    off_loop_wrapper: bool  # the call IS to_thread/submit/... itself
+
+
+@dataclass(eq=False)
+class FunctionInfo:
+    qualname: str  # module-relative: "Scheduler._trip_breaker"
+    module: "ModuleInfo"
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    is_async: bool
+    cls: "ClassInfo | None"
+    calls: list[CallSite] = field(default_factory=list)
+    # function refs registered as loop callbacks inside this function
+    registered_callbacks: list[str] = field(default_factory=list)
+    # local name -> class simple name (from ``x = ClassName(...)`` and
+    # annotated params)
+    local_types: dict[str, str] = field(default_factory=dict)
+    is_loop_callback: bool = False  # set by index linking
+
+    @property
+    def name(self) -> str:
+        return self.qualname.rsplit(".", 1)[-1]
+
+    @property
+    def full_qualname(self) -> str:
+        return f"{self.module.modname}.{self.qualname}"
+
+
+@dataclass(eq=False)
+class ClassInfo:
+    name: str
+    qualname: str
+    module: "ModuleInfo"
+    node: ast.ClassDef
+    bases: list[str] = field(default_factory=list)
+    methods: dict[str, FunctionInfo] = field(default_factory=dict)
+    attr_types: dict[str, str] = field(default_factory=dict)  # self.x -> cls
+
+
+@dataclass(eq=False)
+class ModuleInfo:
+    path: Path
+    relpath: str
+    modname: str  # "finchat_tpu.engine.scheduler"
+    tree: ast.Module
+    source: str
+    imports: dict[str, str] = field(default_factory=dict)  # alias -> dotted
+    functions: dict[str, FunctionInfo] = field(default_factory=dict)
+    classes: dict[str, ClassInfo] = field(default_factory=dict)
+    suppressions: list[Suppression] = field(default_factory=list)
+    hot_marks: set[int] = field(default_factory=set)  # def lines marked hot
+    # (lineno, end_lineno, def_lineno) for every class/function scope
+    scopes: list[tuple[int, int, int]] = field(default_factory=list)
+
+
+def _annotation_class(node: ast.AST | None) -> str | None:
+    """Best-effort simple class name out of an annotation: ``Foo``,
+    ``"Foo"``, ``Foo | None``, ``Optional[Foo]``."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        name = node.value.replace("Optional[", "").rstrip("]")
+        name = name.split("|")[0].strip()
+        return name.rsplit(".", 1)[-1] if name.isidentifier() or "." in name else None
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.BitOr):
+        for side in (node.left, node.right):
+            got = _annotation_class(side)
+            if got and got != "None":
+                return got
+        return None
+    if isinstance(node, ast.Subscript):  # Optional[Foo], list[Foo] -> Foo-ish
+        base = _annotation_class(node.value)
+        if base == "Optional":
+            return _annotation_class(node.slice)
+        return None
+    return None
+
+
+class _FunctionBodyVisitor(ast.NodeVisitor):
+    """Collect call sites / callback registrations / local types for ONE
+    function, without descending into nested defs (indexed separately).
+    Lambdas passed to off-loop wrappers are skipped entirely (their bodies
+    run on a worker thread); all other arguments of those wrappers still
+    evaluate on the calling thread and are visited."""
+
+    def __init__(self, info: FunctionInfo):
+        self.info = info
+        self._depth = 0
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        if self._depth == 0:
+            self._depth += 1
+            # annotated params are typed locals
+            args = node.args
+            for a in list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs):
+                cls = _annotation_class(a.annotation)
+                if cls:
+                    self.info.local_types[a.arg] = cls
+            self.generic_visit(node)
+            self._depth -= 1
+        # nested def: do not descend (its body belongs to the nested fn)
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if (
+            isinstance(node.value, ast.Call)
+            and isinstance(node.value.func, ast.Name)
+            and node.value.func.id[:1].isupper()
+        ):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    self.info.local_types[tgt.id] = node.value.func.id
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        dotted = dotted_name(node.func)
+        tail = dotted.rsplit(".", 1)[-1] if dotted else (
+            node.func.attr if isinstance(node.func, ast.Attribute) else None
+        )
+        off_loop = tail in _OFF_LOOP_WRAPPERS
+        self.info.calls.append(CallSite(node, dotted, off_loop))
+        if tail in _CALLBACK_REGISTRARS:
+            for arg in node.args:
+                ref = dotted_name(arg)
+                if ref:
+                    self.info.registered_callbacks.append(ref)
+        # visit children; for off-loop wrappers skip Lambda args only
+        self.visit(node.func) if not isinstance(node.func, ast.Name) else None
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if off_loop and isinstance(arg, ast.Lambda):
+                continue
+            self.visit(arg)
+
+
+class ProjectIndex:
+    """All analyzed modules plus cross-module resolution helpers."""
+
+    def __init__(self, root: Path):
+        self.root = root
+        self.modules: dict[str, ModuleInfo] = {}  # relpath -> info
+        self._classes_by_name: dict[str, list[ClassInfo]] = {}
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def build(cls, root: Path, paths: list[Path]) -> "ProjectIndex":
+        index = cls(root)
+        for p in _collect_py_files(paths):
+            index._add_file(p)
+        index._link()
+        return index
+
+    def _add_file(self, path: Path) -> None:
+        try:
+            source = path.read_text()
+            tree = ast.parse(source)
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            # an unparseable file is itself reported (rule "parse-error")
+            # by run_analysis; record a stub so the finding has a home
+            rel = self._rel(path)
+            mod = ModuleInfo(path, rel, _modname(rel), ast.Module(body=[], type_ignores=[]), "")
+            mod.suppressions = []
+            self.modules[rel] = mod
+            mod.parse_error = str(e)  # type: ignore[attr-defined]
+            return
+        rel = self._rel(path)
+        mod = ModuleInfo(path, rel, _modname(rel), tree, source)
+        self._scan_comments(mod)
+        self._scan_imports(mod)
+        self._scan_defs(mod)
+        self.modules[rel] = mod
+
+    def _rel(self, path: Path) -> str:
+        try:
+            return path.resolve().relative_to(self.root.resolve()).as_posix()
+        except ValueError:
+            return path.as_posix()
+
+    def _scan_comments(self, mod: ModuleInfo) -> None:
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(mod.source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT or "finchat-lint" not in tok.string:
+                    continue
+                m = _SUPPRESS_RE.search(tok.string)
+                if not m:
+                    continue
+                if m.group("kind") == "hot":
+                    mod.hot_marks.add(tok.start[0])
+                    continue
+                rules = tuple(
+                    r.strip() for r in (m.group("rules") or "").split(",") if r.strip()
+                )
+                mod.suppressions.append(
+                    Suppression(tok.start[0], rules, bool(m.group("why")))
+                )
+        except tokenize.TokenError:
+            pass
+
+    def _scan_imports(self, mod: ModuleInfo) -> None:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.asname:
+                        mod.imports[alias.asname] = alias.name
+                    else:
+                        # `import a.b` binds the name `a` (to package a),
+                        # NOT `a.b` — mapping 'a' -> 'a.b' would resolve
+                        # `a.x(...)` as 'a.b.x' and silently miss e.g.
+                        # os.fsync under `import os.path`
+                        head = alias.name.split(".")[0]
+                        mod.imports[head] = head
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    mod.imports[alias.asname or alias.name] = f"{node.module}.{alias.name}"
+
+    def _scan_defs(self, mod: ModuleInfo) -> None:
+        def add_function(node, prefix: str, cls: ClassInfo | None) -> None:
+            qual = f"{prefix}{node.name}"
+            info = FunctionInfo(
+                qualname=qual,
+                module=mod,
+                node=node,
+                is_async=isinstance(node, ast.AsyncFunctionDef),
+                cls=cls,
+            )
+            _FunctionBodyVisitor(info).visit(node)
+            mod.functions[qual] = info
+            mod.scopes.append((node.lineno, node.end_lineno or node.lineno, node.lineno))
+            if cls is not None and "." not in qual[len(cls.qualname) + 1 :]:
+                cls.methods[node.name] = info
+            # nested defs
+            for child in ast.walk(node):
+                if child is node:
+                    continue
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    if _innermost_parent(node, child) is node:
+                        add_function(child, f"{qual}.", cls)
+
+        def add_class(node: ast.ClassDef, prefix: str) -> None:
+            cls = ClassInfo(
+                name=node.name,
+                qualname=f"{prefix}{node.name}",
+                module=mod,
+                node=node,
+                bases=[b for b in (dotted_name(x) for x in node.bases) if b],
+            )
+            mod.classes[cls.qualname] = cls
+            mod.scopes.append((node.lineno, node.end_lineno or node.lineno, node.lineno))
+            self._classes_by_name.setdefault(node.name, []).append(cls)
+            for child in node.body:
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    add_function(child, f"{cls.qualname}.", cls)
+                elif isinstance(child, ast.ClassDef):
+                    add_class(child, f"{cls.qualname}.")
+
+        for node in mod.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                add_function(node, "", None)
+            elif isinstance(node, ast.ClassDef):
+                add_class(node, "")
+
+    def _link(self) -> None:
+        """Second pass: infer class attr types and mark loop callbacks."""
+        for mod in self.modules.values():
+            for cls in mod.classes.values():
+                self._infer_attr_types(cls)
+        # registered callbacks become loop roots
+        for mod in self.modules.values():
+            for fn in mod.functions.values():
+                for ref in fn.registered_callbacks:
+                    target = self._resolve_callable_ref(ref, fn)
+                    if target is not None:
+                        target.is_loop_callback = True
+
+    def _infer_attr_types(self, cls: ClassInfo) -> None:
+        for fn in cls.methods.values():
+            for node in ast.walk(fn.node):
+                if isinstance(node, ast.Assign):
+                    value_cls = self._value_class(node.value, fn)
+                    if not value_cls:
+                        continue
+                    for tgt in node.targets:
+                        if (
+                            isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"
+                        ):
+                            cls.attr_types.setdefault(tgt.attr, value_cls)
+                elif isinstance(node, ast.AnnAssign):
+                    tgt = node.target
+                    if (
+                        isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                    ):
+                        got = _annotation_class(node.annotation)
+                        if got:
+                            cls.attr_types.setdefault(tgt.attr, got)
+
+    def _value_class(self, value: ast.AST, fn: FunctionInfo) -> str | None:
+        """Best-effort class of an assigned expression: a constructor
+        call, a return-annotated factory call, a typed name, or the first
+        typeable operand of an ``x or default()`` fallback chain."""
+        if isinstance(value, ast.Call) and isinstance(value.func, ast.Name):
+            if value.func.id[:1].isupper():
+                return value.func.id
+            return self._factory_return(value.func.id, fn.module)
+        if isinstance(value, ast.Name):
+            return fn.local_types.get(value.id)
+        if isinstance(value, ast.BoolOp):
+            for operand in value.values:
+                got = self._value_class(operand, fn)
+                if got:
+                    return got
+        if isinstance(value, ast.IfExp):
+            return self._value_class(value.body, fn) or self._value_class(
+                value.orelse, fn
+            )
+        return None
+
+    def _factory_return(self, name: str, mod: ModuleInfo) -> str | None:
+        """Return-annotation class of a module-level function called by
+        bare name (same module or imported)."""
+        fn = mod.functions.get(name)
+        if fn is None:
+            imp = mod.imports.get(name)
+            hits = self._by_dotted(imp) if imp else []
+            fn = hits[0] if hits else None
+        if fn is None:
+            return None
+        return _annotation_class(getattr(fn.node, "returns", None))
+
+    # -- resolution --------------------------------------------------------
+    def class_by_name(self, name: str) -> ClassInfo | None:
+        hits = self._classes_by_name.get(name) or []
+        return hits[0] if len(hits) == 1 else None
+
+    def _method_of(self, cls: ClassInfo, name: str) -> FunctionInfo | None:
+        seen: set[str] = set()
+        stack = [cls]
+        while stack:
+            c = stack.pop()
+            if c.qualname in seen:
+                continue
+            seen.add(c.qualname)
+            if name in c.methods:
+                return c.methods[name]
+            for base in c.bases:
+                bc = self.class_by_name(base.rsplit(".", 1)[-1])
+                if bc is not None:
+                    stack.append(bc)
+        return None
+
+    def _resolve_callable_ref(self, ref: str, ctx: FunctionInfo) -> FunctionInfo | None:
+        """Resolve a bare callable REFERENCE (not a call): ``_done``,
+        ``self._on_tick`` — used for loop-callback registration."""
+        parts = ref.split(".")
+        if len(parts) == 1:
+            # nested function of the current function chain, else module fn
+            probe = ctx.qualname
+            while probe:
+                cand = ctx.module.functions.get(f"{probe}.{parts[0]}")
+                if cand is not None:
+                    return cand
+                probe = probe.rsplit(".", 1)[0] if "." in probe else ""
+            return ctx.module.functions.get(parts[0])
+        if parts[0] == "self" and len(parts) == 2 and ctx.cls is not None:
+            return self._method_of(ctx.cls, parts[1])
+        return None
+
+    def resolve_call(self, site: CallSite, ctx: FunctionInfo) -> list[FunctionInfo]:
+        """Package-internal callee candidates for a call site (possibly
+        empty). External calls resolve to [] — use ``external_target`` for
+        the dotted stdlib form."""
+        dotted = site.dotted
+        if not dotted:
+            return []
+        parts = dotted.split(".")
+        mod = ctx.module
+        if len(parts) == 1:
+            name = parts[0]
+            # nested function of this function (or an enclosing one)
+            got = self._resolve_callable_ref(name, ctx)
+            if got is not None:
+                return [got]
+            # imported function from a package module
+            imp = mod.imports.get(name)
+            if imp:
+                return self._by_dotted(imp)
+            # class constructor
+            cls = mod.classes.get(name) or self.class_by_name(name)
+            if cls is not None:
+                init = self._method_of(cls, "__init__")
+                return [init] if init else []
+            return []
+        if parts[0] == "self" and ctx.cls is not None:
+            if len(parts) == 2:
+                got = self._method_of(ctx.cls, parts[1])
+                return [got] if got else []
+            if len(parts) == 3:
+                attr_cls = ctx.cls.attr_types.get(parts[1])
+                if attr_cls:
+                    cls = self.class_by_name(attr_cls)
+                    if cls is not None:
+                        got = self._method_of(cls, parts[2])
+                        return [got] if got else []
+            return []
+        if len(parts) == 2:
+            root, meth = parts
+            # typed local / annotated param
+            local_cls = ctx.local_types.get(root)
+            if local_cls:
+                cls = self.class_by_name(local_cls)
+                if cls is not None:
+                    got = self._method_of(cls, meth)
+                    return [got] if got else []
+            # imported module or name
+            imp = mod.imports.get(root)
+            if imp:
+                return self._by_dotted(f"{imp}.{meth}")
+            # class method via class name
+            cls = mod.classes.get(root) or self.class_by_name(root)
+            if cls is not None:
+                got = self._method_of(cls, meth)
+                return [got] if got else []
+        return []
+
+    def _by_dotted(self, dotted: str) -> list[FunctionInfo]:
+        """``finchat_tpu.engine.scheduler.Scheduler.submit`` (or any
+        suffix-qualified package function) -> FunctionInfo."""
+        for mod in self.modules.values():
+            if dotted.startswith(mod.modname + "."):
+                qual = dotted[len(mod.modname) + 1 :]
+                if qual in mod.functions:
+                    return [mod.functions[qual]]
+                # ClassName alone: constructor
+                if qual in mod.classes:
+                    init = self._method_of(mod.classes[qual], "__init__")
+                    return [init] if init else []
+        return []
+
+    def external_target(self, site: CallSite, ctx: FunctionInfo) -> str | None:
+        """The import-resolved dotted name (``time.sleep``) when the call
+        does NOT resolve inside the package."""
+        dotted = site.dotted
+        if not dotted:
+            return None
+        parts = dotted.split(".")
+        imp = ctx.module.imports.get(parts[0])
+        if imp:
+            return ".".join([imp] + parts[1:])
+        return dotted
+
+    def all_functions(self):
+        for mod in self.modules.values():
+            yield from mod.functions.values()
+
+    # -- suppressions ------------------------------------------------------
+    def suppression_for(self, finding: Finding) -> Suppression | None:
+        mod = self.modules.get(finding.path)
+        if mod is None:
+            return None
+        candidates: list[tuple[int, Suppression]] = []
+        for sup in mod.suppressions:
+            if sup.rules and finding.rule not in sup.rules:
+                continue
+            if sup.line == finding.line:
+                candidates.append((0, sup))
+                continue
+            # scope suppression: comment sits on a def/class line whose
+            # scope contains the finding
+            for lo, hi, def_line in mod.scopes:
+                if sup.line == def_line and lo <= finding.line <= hi:
+                    candidates.append((hi - lo, sup))
+                    break
+        if not candidates:
+            return None
+        candidates.sort(key=lambda c: c[0])  # innermost scope wins
+        sup = candidates[0][1]
+        sup.used = True
+        return sup
+
+
+def _innermost_parent(root: ast.AST, target: ast.AST) -> ast.AST | None:
+    """The innermost def/class between ``root`` and ``target`` (``root``
+    itself when the def is directly nested)."""
+    parent = root
+    found = root
+
+    def walk(node: ast.AST, scope: ast.AST) -> None:
+        nonlocal found
+        for child in ast.iter_child_nodes(node):
+            if child is target:
+                found = scope
+                return
+            next_scope = (
+                child
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef))
+                else scope
+            )
+            walk(child, next_scope)
+
+    walk(parent, parent)
+    return found
+
+
+def _modname(relpath: str) -> str:
+    parts = Path(relpath).with_suffix("").parts
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def _collect_py_files(paths: list[Path]) -> list[Path]:
+    out: list[Path] = []
+    for p in paths:
+        if p.is_dir():
+            out.extend(
+                f
+                for f in sorted(p.rglob("*.py"))
+                if "__pycache__" not in f.parts and ".git" not in f.parts
+            )
+        elif p.suffix == ".py":
+            out.append(p)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+
+
+class Rule:
+    """Base class: subclasses set ``name``/``code``/``description`` and
+    implement ``run``."""
+
+    name = "abstract"
+    code = "R0"
+    description = ""
+
+    def run(self, project: ProjectIndex) -> list[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+def default_rules() -> list[Rule]:
+    # imported here to avoid import cycles (rule modules import core)
+    from finchat_tpu.analysis.rules_blocking import EventLoopBlockingRule
+    from finchat_tpu.analysis.rules_config import KnobConsistencyRule
+    from finchat_tpu.analysis.rules_hotpath import HotPathHostSyncRule
+    from finchat_tpu.analysis.rules_metrics import MetricsDisciplineRule
+    from finchat_tpu.analysis.rules_resources import ResourcePairingRule
+
+    return [
+        EventLoopBlockingRule(),
+        HotPathHostSyncRule(),
+        ResourcePairingRule(),
+        KnobConsistencyRule(),
+        MetricsDisciplineRule(),
+    ]
+
+
+@dataclass
+class AnalysisResult:
+    findings: list[Finding]  # unsuppressed
+    suppressed: list[tuple[Finding, Suppression]]
+    meta_findings: list[Finding]  # suppression-discipline, parse errors
+    unused_suppressions: list[tuple[str, int]]  # (path, line)
+
+
+def run_analysis(
+    root: Path,
+    paths: list[Path],
+    rules: list[Rule] | None = None,
+    rule_filter: set[str] | None = None,
+) -> AnalysisResult:
+    project = ProjectIndex.build(root, paths)
+    rules = rules if rules is not None else default_rules()
+    if rule_filter:
+        rules = [r for r in rules if r.name in rule_filter or r.code in rule_filter]
+
+    findings: list[Finding] = []
+    suppressed: list[tuple[Finding, Suppression]] = []
+    meta: list[Finding] = []
+
+    for mod in project.modules.values():
+        err = getattr(mod, "parse_error", None)
+        if err:
+            meta.append(Finding("parse-error", mod.relpath, 1, "", f"cannot parse: {err}"))
+
+    for rule in rules:
+        for finding in rule.run(project):
+            sup = project.suppression_for(finding)
+            if sup is not None:
+                suppressed.append((finding, sup))
+            else:
+                findings.append(finding)
+
+    # suppression discipline: every disable needs a justification; unused
+    # disables are surfaced so dead suppressions don't hide future drift
+    unused: list[tuple[str, int]] = []
+    for mod in project.modules.values():
+        for sup in mod.suppressions:
+            if not sup.justified:
+                meta.append(
+                    Finding(
+                        "suppression-discipline",
+                        mod.relpath,
+                        sup.line,
+                        "",
+                        "suppression lacks a justification "
+                        "(write `# finchat-lint: disable=<rule> -- why`)",
+                    )
+                )
+            if not sup.used:
+                unused.append((mod.relpath, sup.line))
+
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return AnalysisResult(findings, suppressed, meta, unused)
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+
+def load_baseline(path: Path) -> dict[str, dict]:
+    if not path.exists():
+        return {}
+    data = json.loads(path.read_text())
+    return data.get("findings", {})
+
+
+def write_baseline(path: Path, findings: list[Finding]) -> None:
+    payload = {
+        "version": 1,
+        "comment": (
+            "finchat-lint baseline: pre-existing findings tolerated by CI. "
+            "This file may only SHRINK — fix or inline-suppress (with "
+            "justification) instead of adding entries. Regenerate with "
+            "`python -m finchat_tpu.analysis --update-baseline` after "
+            "removing a finding."
+        ),
+        "findings": {
+            f.fingerprint(): {
+                "rule": f.rule,
+                "path": f.path,
+                "symbol": f.symbol,
+                "message": f.message,
+            }
+            for f in findings
+        },
+    }
+    path.write_text(json.dumps(payload, indent=2, sort_keys=True) + "\n")

@@ -1,0 +1,202 @@
+"""R1 ``event-loop-blocking``: blocking primitives reachable from the
+asyncio scheduler loop.
+
+The shipped bug class (PRs 5-7 review rounds): seconds-class work — device
+rebuilds, serialize+fsync spills — running directly on the event loop that
+every in-flight stream (and, since the fleet, every SIBLING replica)
+shares. The blessed pattern is a worker-thread seam (``asyncio.to_thread``,
+``run_in_executor``, the session disk tier's write-behind worker); this
+rule finds the paths that skip it.
+
+Mechanics: every ``async def`` body and every function registered as a
+loop callback (``add_done_callback`` / ``call_soon`` / ...) is a root.
+The package call graph is walked from the roots — including into *sync*
+callees (a sync helper called from a coroutine still runs on the loop)
+and *awaited async* callees (awaiting doesn't offload) — and every
+reachable blocking primitive is reported at its own line, with the
+root-to-primitive chain in the message. Off-loop boundaries prune the
+walk: a callable passed BY REFERENCE to ``to_thread`` / ``submit`` /
+``run_in_executor`` / ``Thread`` never creates an edge, and a lambda
+argument of those wrappers is skipped entirely; their sibling arguments
+still evaluate on the loop and ARE visited.
+
+Blocking primitives:
+
+- ``time.sleep``
+- ``os.fsync`` / ``os.fdatasync`` / ``os.sync`` (and any ``.fsync()``)
+- ``jax.block_until_ready`` / any ``.block_until_ready()``
+- device-rebuild entry points (``rebuild_device_state``)
+- executor joins (``....submit(...).result()``)
+- blocking file opens (builtin ``open``)
+
+Allowlist (the blessed off-loop seams, per STATIC_ANALYSIS.md): the
+session disk tier's writer-thread bodies — reachable inline only in the
+sync-write test mode — are pruned here; everything else blessed in-tree
+carries an inline suppression WITH its justification at the seam itself,
+so the why lives next to the code.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import deque
+from dataclasses import dataclass
+
+from finchat_tpu.analysis.core import (
+    CallSite,
+    Finding,
+    FunctionInfo,
+    ProjectIndex,
+    Rule,
+    dotted_name,
+)
+
+# import-resolved dotted names that block the calling thread
+BLOCKING_DOTTED = {
+    "time.sleep": "time.sleep",
+    "os.fsync": "os.fsync",
+    "os.fdatasync": "os.fdatasync",
+    "os.sync": "os.sync",
+    "jax.block_until_ready": "jax.block_until_ready",
+}
+
+# attribute tails that block regardless of receiver type
+BLOCKING_METHODS = {
+    "block_until_ready": "device sync (.block_until_ready)",
+    "rebuild_device_state": "device-state rebuild (seconds of device work)",
+    "fsync": "fsync",
+}
+
+# blessed off-loop seams: traversal never descends into (or reports
+# inside) functions whose full qualname ends with one of these. Keep this
+# list SHORT — prefer an inline suppression at the seam, where the
+# justification lives with the code. These two are the session disk
+# tier's writer-thread bodies: on the production path they only ever run
+# on the write-behind worker; the inline fallback exists for the
+# sync-write test mode.
+ALLOWED_SEAMS = (
+    "SessionDiskTier._write_record",
+    "SessionDiskTier._discard_now",
+)
+
+# async functions that are STARTUP/BOOT paths, not serving-loop paths:
+# they run before any stream is live (App.start launches the consume task
+# as its last act), so blocking there is the documented boot cost —
+# checkpoint loads, warmup compiles, journal replay. They are skipped as
+# roots; their helpers are still checked when some serving-path root
+# reaches them.
+STARTUP_ROOTS = ("start", "main")
+
+
+@dataclass(frozen=True)
+class _Primitive:
+    line: int
+    desc: str
+
+
+class EventLoopBlockingRule(Rule):
+    name = "event-loop-blocking"
+    code = "R1"
+    description = (
+        "blocking calls (fsync/sleep/device sync/rebuild/executor join/"
+        "file open) reachable from async defs or registered loop callbacks"
+    )
+
+    def run(self, project: ProjectIndex) -> list[Finding]:
+        primitives = {fn: self._primitives(fn, project) for fn in project.all_functions()}
+        edges = {fn: self._edges(fn, project) for fn in project.all_functions()}
+
+        roots = [
+            fn
+            for fn in project.all_functions()
+            if (fn.is_async or fn.is_loop_callback)
+            and not _allowlisted(fn)
+            and fn.name not in STARTUP_ROOTS
+        ]
+        # BFS from all roots at once; per function remember the shortest
+        # chain (list of qualnames root..fn) that reaches it
+        chain: dict[FunctionInfo, list[str]] = {}
+        q: deque[FunctionInfo] = deque()
+        for root in sorted(roots, key=lambda f: (f.module.relpath, f.qualname)):
+            if root not in chain:
+                chain[root] = [root.qualname]
+                q.append(root)
+        while q:
+            fn = q.popleft()
+            for callee in edges[fn]:
+                if callee in chain or _allowlisted(callee):
+                    continue
+                chain[callee] = chain[fn] + [callee.qualname]
+                q.append(callee)
+
+        findings: list[Finding] = []
+        seen: set[tuple[str, int, str]] = set()
+        for fn, path in chain.items():
+            for prim in primitives[fn]:
+                key = (fn.module.relpath, prim.line, prim.desc)
+                if key in seen:
+                    continue
+                seen.add(key)
+                if len(path) == 1:
+                    via = f"directly in async `{path[0]}`"
+                else:
+                    via = f"reachable from `{path[0]}` via " + " -> ".join(path[1:])
+                findings.append(
+                    Finding(
+                        self.name,
+                        fn.module.relpath,
+                        prim.line,
+                        fn.qualname,
+                        f"{prim.desc} may block the event loop; {via} "
+                        "(move it behind asyncio.to_thread / the write-"
+                        "behind worker, or suppress with a justification)",
+                    )
+                )
+        return findings
+
+    # -- per-function scans ------------------------------------------------
+    def _primitives(self, fn: FunctionInfo, project: ProjectIndex) -> list[_Primitive]:
+        out: list[_Primitive] = []
+        for site in fn.calls:
+            node = site.node
+            # builtin open()
+            if isinstance(node.func, ast.Name) and node.func.id == "open":
+                if "open" not in fn.module.imports:
+                    out.append(_Primitive(node.lineno, "blocking file `open()`"))
+                continue
+            # executor join: <...>.submit(...).result()
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "result"
+                and isinstance(node.func.value, ast.Call)
+            ):
+                inner = dotted_name(node.func.value.func)
+                if inner and inner.rsplit(".", 1)[-1] == "submit":
+                    out.append(
+                        _Primitive(node.lineno, "executor join (`.submit(...).result()`)")
+                    )
+                    continue
+            ext = project.external_target(site, fn)
+            if ext in BLOCKING_DOTTED:
+                out.append(_Primitive(node.lineno, f"`{BLOCKING_DOTTED[ext]}`"))
+                continue
+            if isinstance(node.func, ast.Attribute):
+                tail = node.func.attr
+                if tail in BLOCKING_METHODS:
+                    # the NAME is the contract (a rebuild_device_state is
+                    # seconds of device work no matter how it resolves)
+                    out.append(_Primitive(node.lineno, BLOCKING_METHODS[tail]))
+        return out
+
+    def _edges(self, fn: FunctionInfo, project: ProjectIndex) -> list[FunctionInfo]:
+        out: list[FunctionInfo] = []
+        for site in fn.calls:
+            if site.off_loop_wrapper:
+                continue  # the callable arg runs on a worker thread
+            out.extend(project.resolve_call(site, fn))
+        return out
+
+
+def _allowlisted(fn: FunctionInfo) -> bool:
+    full = fn.full_qualname
+    return any(full.endswith(seam) for seam in ALLOWED_SEAMS)

@@ -1,0 +1,187 @@
+"""R4 ``knob-consistency``: config knobs, env vars, CLI flags, and README
+docs must agree.
+
+The config tree (``utils/config.py``) grew ~50 knobs across PRs 1-7, each
+supposed to ship with its env var, its CLI flag where one is declared,
+and a README mention. The drift is real: at ISSUE 8 time, 23 env vars
+wired in ``load_config`` had never made it into README.md. This rule
+makes the contract mechanical:
+
+- (a) every env var name read in ``load_config`` must appear in
+  ``README.md`` (docs drift),
+- (b) every ``cfg.<section>.<key>`` assignment in ``load_config`` must
+  target a declared dataclass field (wiring typos),
+- (c) every CLI override key in ``__main__.py``
+  (``overrides["section.key"] = ...``) must target a declared field
+  (flag drift),
+- (d) every ``*Config`` dataclass field must be wired to an env var in
+  ``load_config`` — knobs that are deliberately config-file/CLI-only
+  carry an inline suppression on the field (or class) line saying so.
+
+The rule is self-scoping: it runs only when the analyzed set contains a
+``utils/config.py``; fixtures exercise it with a miniature tree.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from finchat_tpu.analysis.core import Finding, ModuleInfo, ProjectIndex, Rule, dotted_name
+
+_ENV_READERS = {"_env", "_env_bool", "_env_int", "_env_float"}
+
+
+class KnobConsistencyRule(Rule):
+    name = "knob-consistency"
+    code = "R4"
+    description = (
+        "config knobs <-> env vars <-> CLI flags <-> README stay in sync"
+    )
+
+    def run(self, project: ProjectIndex) -> list[Finding]:
+        cfg_mod = next(
+            (m for m in project.modules.values() if m.relpath.endswith("utils/config.py")),
+            None,
+        )
+        if cfg_mod is None:
+            return []
+        findings: list[Finding] = []
+
+        # --- declared fields per Config class ---
+        fields: dict[str, dict[str, int]] = {}  # class -> field -> line
+        for cls in cfg_mod.classes.values():
+            if not cls.name.endswith("Config"):
+                continue
+            fields[cls.name] = {}
+            for node in cls.node.body:
+                if isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
+                    fields[cls.name][node.target.id] = node.lineno
+
+        # --- section name -> Config class (from AppConfig fields) ---
+        sections: dict[str, str] = {}
+        app_cls = cfg_mod.classes.get("AppConfig")
+        if app_cls is not None:
+            for node in app_cls.node.body:
+                if isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
+                    cls_name = _field_class(node)
+                    if cls_name:
+                        sections[node.target.id] = cls_name
+
+        def field_exists(section: str, key: str) -> bool:
+            cls_name = sections.get(section)
+            return bool(cls_name) and key in fields.get(cls_name, {})
+
+        # --- env wiring in load_config ---
+        load_fn = cfg_mod.functions.get("load_config")
+        env_names: dict[str, int] = {}  # env var -> line
+        wired: set[tuple[str, str]] = set()  # (section, key)
+        if load_fn is not None:
+            for node in ast.walk(load_fn.node):
+                if not isinstance(node, ast.Assign) or not isinstance(node.value, ast.Call):
+                    continue
+                func = node.value.func
+                if not (isinstance(func, ast.Name) and func.id in _ENV_READERS):
+                    continue
+                if node.value.args and isinstance(node.value.args[0], ast.Constant):
+                    env_names[str(node.value.args[0].value)] = node.lineno
+                for tgt in node.targets:
+                    d = dotted_name(tgt)
+                    if d and d.startswith("cfg.") and d.count(".") == 2:
+                        _, section, key = d.split(".")
+                        wired.add((section, key))
+                        if not field_exists(section, key):
+                            findings.append(
+                                Finding(
+                                    self.name,
+                                    cfg_mod.relpath,
+                                    node.lineno,
+                                    "load_config",
+                                    f"env wiring targets `{section}.{key}` "
+                                    "but no such config field is declared",
+                                )
+                            )
+
+        # --- (a) README mentions ---
+        readme = _read_readme(project.root)
+        for env, line in sorted(env_names.items()):
+            if env not in readme:
+                findings.append(
+                    Finding(
+                        self.name,
+                        cfg_mod.relpath,
+                        line,
+                        "load_config",
+                        f"env var `{env}` is wired but never mentioned in "
+                        "README.md (add it to the configuration reference)",
+                    )
+                )
+
+        # --- (c) CLI override keys in __main__.py ---
+        main_mod = next(
+            (m for m in project.modules.values() if m.relpath.endswith("__main__.py")
+             and "analysis" not in m.relpath),
+            None,
+        )
+        if main_mod is not None:
+            for node in ast.walk(main_mod.tree):
+                if not isinstance(node, ast.Assign):
+                    continue
+                for tgt in node.targets:
+                    if (
+                        isinstance(tgt, ast.Subscript)
+                        and isinstance(tgt.slice, ast.Constant)
+                        and isinstance(tgt.slice.value, str)
+                        and "." in tgt.slice.value
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "overrides"
+                    ):
+                        section, _, key = tgt.slice.value.partition(".")
+                        if not field_exists(section, key):
+                            findings.append(
+                                Finding(
+                                    self.name,
+                                    main_mod.relpath,
+                                    node.lineno,
+                                    "main",
+                                    f"CLI override targets `{section}.{key}` "
+                                    "but no such config field is declared",
+                                )
+                            )
+
+        # --- (d) every declared field has env wiring ---
+        reverse_sections = {v: k for k, v in sections.items()}
+        for cls_name, cls_fields in sorted(fields.items()):
+            section = reverse_sections.get(cls_name)
+            if section is None:
+                continue  # not reachable from AppConfig
+            for key, line in sorted(cls_fields.items()):
+                if (section, key) not in wired:
+                    findings.append(
+                        Finding(
+                            self.name,
+                            cfg_mod.relpath,
+                            line,
+                            cls_name,
+                            f"knob `{section}.{key}` has no env var wired in "
+                            "load_config (wire one, or suppress on the "
+                            "field line if it is config-file/CLI-only by "
+                            "design)",
+                        )
+                    )
+        return findings
+
+
+def _field_class(node: ast.AnnAssign) -> str | None:
+    ann = node.annotation
+    if isinstance(ann, ast.Name) and ann.id.endswith("Config"):
+        return ann.id
+    return None
+
+
+def _read_readme(root: Path) -> str:
+    p = root / "README.md"
+    try:
+        return p.read_text()
+    except OSError:
+        return ""

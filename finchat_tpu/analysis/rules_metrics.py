@@ -1,0 +1,196 @@
+"""R5 ``metrics-discipline``: metric naming and the labeled-vs-unlabeled
+family convention.
+
+The Prometheus surface is the product's north star (utils/metrics.py);
+PR 6 established the convention this rule enforces mechanically:
+
+- every series is ``finchat_*``,
+- counters (``inc``) end ``_total``; histograms (``observe`` / ``Timer``)
+  end ``_seconds``; gauges (``set_gauge``) end in neither,
+- per-engine families are emitted through the replica's ``LabeledMetrics``
+  view (``self.metrics`` — the ``replica`` label rides implicitly), while
+  **fleet-level** series (``finchat_fleet_*``) are emitted UNLABELED on
+  the global ``METRICS`` registry — one reader sees the whole family. A
+  fleet counter emitted through a labeled view was exactly the PR 6
+  review catch (per-replica ``finchat_fleet_drain_failures_total`` series
+  that no dashboard summed),
+- one series name must not mix explicit-``labels`` and label-free call
+  sites (the render groups by base name; a mixed family splits).
+
+Emission sites are found by shape, not receiver type: a call to
+``inc`` / ``set_gauge`` / ``observe`` whose first argument is a string
+literal (or a conditional between string literals), or a ``Timer(...,
+"name")`` construction. Sites outside ``finchat_tpu/`` (tests, bench
+fixtures) are ignored.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from finchat_tpu.analysis.core import Finding, ProjectIndex, Rule, dotted_name
+
+_EMITTERS = {"inc", "set_gauge", "observe"}
+
+
+class MetricsDisciplineRule(Rule):
+    name = "metrics-discipline"
+    code = "R5"
+    description = (
+        "finchat_* naming, _total/_seconds suffix conventions, and the "
+        "fleet-family unlabeled-emission convention"
+    )
+
+    def run(self, project: ProjectIndex) -> list[Finding]:
+        findings: list[Finding] = []
+        # name -> list of (has_explicit_labels, Finding-location tuple)
+        sites: dict[str, list[tuple[bool, str, int, str]]] = {}
+
+        for mod in project.modules.values():
+            if not mod.modname.startswith("finchat_tpu."):
+                continue
+            if mod.relpath.endswith("utils/metrics.py"):
+                continue  # the registry's own internals
+            for fn in mod.functions.values():
+                labeled_view = _class_uses_labeled_view(fn)
+                for site in fn.calls:
+                    for kind, name, node in _emissions(site.node):
+                        receiver = (site.dotted or "").rsplit(".", 1)[0]
+                        has_labels = any(kw.arg == "labels" for kw in node.keywords)
+                        site_labeled = labeled_view and receiver.endswith("metrics")
+                        if ".labeled(" in ast.unparse(node.func):
+                            site_labeled = True
+                        if name is None:
+                            continue
+                        sites.setdefault(name, []).append(
+                            (has_labels, mod.relpath, node.lineno, fn.qualname)
+                        )
+                        findings.extend(
+                            self._check_one(
+                                kind, name, receiver, has_labels, site_labeled,
+                                mod.relpath, node.lineno, fn.qualname,
+                            )
+                        )
+
+        # mixed labeled/unlabeled families
+        for name, occurrences in sorted(sites.items()):
+            kinds = {has for has, *_ in occurrences}
+            if len(kinds) == 2:
+                for has, relpath, line, qual in occurrences:
+                    if not has:
+                        findings.append(
+                            Finding(
+                                self.name,
+                                relpath,
+                                line,
+                                qual,
+                                f"`{name}` is emitted both with and "
+                                "without explicit labels across the "
+                                "package; a mixed family splits the "
+                                "Prometheus series grouping",
+                            )
+                        )
+        return findings
+
+    def _check_one(
+        self,
+        kind: str,
+        name: str,
+        receiver: str,
+        has_labels: bool,
+        labeled_view: bool,
+        relpath: str,
+        line: int,
+        qual: str,
+    ) -> list[Finding]:
+        out: list[Finding] = []
+
+        def bad(msg: str) -> None:
+            out.append(Finding(self.name, relpath, line, qual, msg))
+
+        if not name.startswith("finchat_"):
+            bad(f"metric `{name}` must be namespaced `finchat_*`")
+        if kind == "inc" and not name.endswith("_total"):
+            bad(f"counter `{name}` must end `_total`")
+        if kind in ("observe", "timer") and not name.endswith("_seconds"):
+            bad(f"histogram `{name}` must end `_seconds`")
+        if kind == "set_gauge" and (
+            name.endswith("_total") or name.endswith("_seconds")
+        ):
+            bad(
+                f"gauge `{name}` must not use a counter/histogram suffix "
+                "(_total/_seconds)"
+            )
+        if name.startswith("finchat_fleet_"):
+            # PR 6 convention: fleet-level series are unlabeled — never
+            # through a replica's LabeledMetrics view and never with
+            # explicit labels. A plain registry receiver (METRICS itself,
+            # or a self.metrics that is never built from `.labeled(...)`)
+            # is fine.
+            if has_labels or labeled_view:
+                bad(
+                    f"fleet-family series `{name}` must be emitted "
+                    "unlabeled on the plain METRICS registry (a labeled "
+                    "view would split it into per-replica series no "
+                    "dashboard sums — the PR 6 convention)"
+                )
+        return out
+
+
+def _class_uses_labeled_view(fn) -> bool:
+    """True when the function's enclosing class ever builds its
+    ``self.metrics`` from a ``.labeled(...)`` view — i.e. instances emit
+    per-replica series implicitly (the scheduler/session-cache pattern)."""
+    cls = fn.cls
+    if cls is None:
+        return False
+    for meth in cls.methods.values():
+        for node in ast.walk(meth.node):
+            if not isinstance(node, ast.Assign):
+                continue
+            tgt_hit = any(
+                isinstance(t, ast.Attribute)
+                and t.attr == "metrics"
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+                for t in node.targets
+            )
+            if not tgt_hit:
+                continue
+            for inner in ast.walk(node.value):
+                if (
+                    isinstance(inner, ast.Call)
+                    and isinstance(inner.func, ast.Attribute)
+                    and inner.func.attr == "labeled"
+                ):
+                    return True
+    return False
+
+
+def _emissions(node: ast.Call):
+    """Yield (kind, metric_name, call_node) for emission-shaped calls.
+    Conditional names (``inc("a" if x else "b")``) yield once per arm."""
+    func = node.func
+    # Timer(registry, "name")
+    if isinstance(func, ast.Name) and func.id == "Timer" and len(node.args) >= 2:
+        for name in _const_strings(node.args[1]):
+            yield "timer", name, node
+        return
+    if not isinstance(func, ast.Attribute) or func.attr not in _EMITTERS:
+        return
+    if not node.args:
+        return
+    names = _const_strings(node.args[0])
+    receiver = dotted_name(func.value) or ""
+    for name in names:
+        # only metric-shaped literals (avoids unrelated .observe/.inc APIs)
+        if name.startswith("finchat_") or "metrics" in receiver.lower() or receiver == "METRICS":
+            yield func.attr, name, node
+
+
+def _const_strings(expr: ast.AST) -> list[str]:
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return [expr.value]
+    if isinstance(expr, ast.IfExp):
+        return _const_strings(expr.body) + _const_strings(expr.orelse)
+    return []

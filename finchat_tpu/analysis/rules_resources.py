@@ -1,0 +1,372 @@
+"""R3 ``resource-pairing``: allocator acquires, slot claims, and refcount
+bumps must be released (or ownership-transferred) on every exit path.
+
+Two shipped bug shapes drive the checks (both fixed by hand in PR 5/6
+review rounds, each with its own bespoke regression test):
+
+1. **Early-exit leak** — a function allocates pages / pops a slot /
+   bumps a refcount, then returns or raises on some path without freeing
+   and without transferring ownership (storing the pages on a handle,
+   returning them, passing them to a successor). Check (a) walks each
+   function with a small branch-aware interpreter and reports resources
+   still open at a ``return`` / ``raise`` / fall-through.
+
+2. **Unguarded device op on a cleanup path** — ``_fail_prefix_job``
+   originally called ``engine.reset_slot`` bare; on a wedged device the
+   raise skipped ``free_slots.append`` and the future resolution,
+   stranding the awaiter forever. Check (b) flags device-op calls that
+   are (i) inside a cleanup-named function (``*fail*`` / ``*evict*`` /
+   ``*release*`` / ``*preempt*`` / ``*drop*`` / ``*cleanup*`` /
+   ``*reap*``) or (ii) inside any ``finally`` / ``except`` block, are
+   NOT wrapped in their own ``try``, and are followed by a release
+   statement that the raise would skip.
+
+Ownership-transfer is deliberately lenient: a resource that escapes
+ANYWHERE in the function (stored into an attribute, returned, passed to
+a non-release call) is treated as transferred and exempt from (a) —
+the scheduler's handle/page-list plumbing hands pages around
+constantly, and a false-positive lint on the serving plane would just
+breed reflexive suppressions.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from finchat_tpu.analysis.core import (
+    Finding,
+    FunctionInfo,
+    ProjectIndex,
+    Rule,
+    dotted_name,
+)
+
+_CLEANUP_NAME = re.compile(r"(fail|evict|release|preempt|drop|cleanup|reap)")
+
+_DEVICE_OPS = {
+    "reset_slot",
+    "reset_slots",
+    "set_page_table_row",
+    "set_page_table_rows",
+    "set_context_lens_rows",
+    "set_last_token",
+    "prefill",
+    "restore_pages",
+    "offload_pages",
+    "rebuild_device_state",
+}
+
+_RELEASE_TAILS = {"free", "append", "appendleft", "set_result", "put_nowait"}
+
+# calls that can neither raise meaningfully nor take ownership
+_SAFE_CALL_ROOTS = {"logger", "logging"}
+_SAFE_BUILTINS = {
+    "len", "list", "min", "max", "sum", "set", "sorted", "enumerate",
+    "zip", "range", "iter", "reversed", "isinstance", "print", "repr",
+    "str", "tuple", "dict", "abs", "id",
+}
+
+
+class ResourcePairingRule(Rule):
+    name = "resource-pairing"
+    code = "R3"
+    description = (
+        "allocator acquires / slot claims / ref bumps released on all "
+        "exit paths; no unguarded device ops ahead of cleanup releases"
+    )
+
+    def run(self, project: ProjectIndex) -> list[Finding]:
+        findings: list[Finding] = []
+        for fn in project.all_functions():
+            findings.extend(self._check_pairing(fn))
+            findings.extend(self._check_cleanup_guard(fn))
+        return findings
+
+    # -- (a) acquire/release pairing --------------------------------------
+    def _check_pairing(self, fn: FunctionInfo) -> list[Finding]:
+        body = getattr(fn.node, "body", [])
+        opens = _collect_opens(body)
+        if not opens:
+            return []
+        escaped = _escaping_vars(body, opens)
+        tracked = {v: line for v, line in opens.items() if v not in escaped}
+        if not tracked:
+            return []
+        findings: list[Finding] = []
+
+        def report(node: ast.AST, var: str, what: str) -> None:
+            findings.append(
+                Finding(
+                    self.name,
+                    fn.module.relpath,
+                    node.lineno,
+                    fn.qualname,
+                    f"resource `{var}` (acquired in this function) is "
+                    f"still open at {what}; release it or transfer "
+                    "ownership on every exit path",
+                )
+            )
+
+        _Interp(tracked, report).run(body)
+        return findings
+
+    # -- (b) unguarded device ops on cleanup paths ------------------------
+    def _check_cleanup_guard(self, fn: FunctionInfo) -> list[Finding]:
+        findings: list[Finding] = []
+        seen: set[int] = set()
+        cleanup_fn = bool(_CLEANUP_NAME.search(fn.name))
+
+        def unguarded(stmts: list[ast.stmt]):
+            """Nodes in these statements NOT under a nested try or def."""
+            for s in stmts:
+                if isinstance(s, ast.Try):
+                    continue
+                yield from _walk_skipping(s, skip_try=True)
+
+        def releases(stmts: list[ast.stmt]) -> list[int]:
+            return [
+                n.lineno
+                for s in stmts
+                for n in _walk_skipping(s, skip_try=False)
+                if _is_release(n)
+            ]
+
+        def scan(stmts: list[ast.stmt], active: bool) -> None:
+            if active:
+                rel = releases(stmts)
+                for n in unguarded(stmts):
+                    if (
+                        isinstance(n, ast.Call)
+                        and _is_device_op(n)
+                        and n.lineno not in seen
+                        and any(line > n.lineno for line in rel)
+                    ):
+                        seen.add(n.lineno)
+                        findings.append(
+                            Finding(
+                                self.name,
+                                fn.module.relpath,
+                                n.lineno,
+                                fn.qualname,
+                                "unguarded device op "
+                                f"`{dotted_name(n.func)}` on a cleanup "
+                                "path with releases after it; if it "
+                                "raises, the releases are skipped "
+                                "(the _fail_prefix_job bug class) — "
+                                "wrap it in try/except",
+                            )
+                        )
+            # except/finally blocks are cleanup contexts in ANY function;
+            # recurse into try bodies (not flagged themselves — they are
+            # guarded) only to discover the trys nested inside them
+            for t in _outermost_trys(stmts):
+                for h in t.handlers:
+                    scan(h.body, True)
+                scan(t.finalbody, True)
+                scan(t.body, False)
+                scan(t.orelse, False)
+
+        scan(getattr(fn.node, "body", []), cleanup_fn)
+        return findings
+
+
+def _walk_skipping(node: ast.AST, skip_try: bool):
+    """Yield ``node`` and descendants, never descending into nested defs,
+    and (optionally) never into ``try`` statements."""
+    yield node
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        if skip_try and isinstance(child, ast.Try):
+            continue
+        yield from _walk_skipping(child, skip_try)
+
+
+def _outermost_trys(stmts: list[ast.stmt]) -> list[ast.Try]:
+    """Try statements within ``stmts`` that are not nested inside another
+    try (nested defs excluded)."""
+    out: list[ast.Try] = []
+
+    def walk(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            if isinstance(child, ast.Try):
+                out.append(child)
+                continue
+            walk(child)
+
+    for s in stmts:
+        if isinstance(s, ast.Try):
+            out.append(s)
+        else:
+            walk(s)
+    return out
+
+
+def _is_device_op(node: ast.Call) -> bool:
+    d = dotted_name(node.func)
+    if not d:
+        return False
+    parts = d.split(".")
+    if parts[-1] not in _DEVICE_OPS:
+        return False
+    recv = parts[:-1]
+    return bool(recv) and recv[-1] in ("engine", "eng", "_engine")
+
+
+def _is_release(node: ast.AST) -> bool:
+    if isinstance(node, ast.Call):
+        d = dotted_name(node.func)
+        return bool(d) and d.rsplit(".", 1)[-1] in _RELEASE_TAILS
+    if isinstance(node, ast.AugAssign) and isinstance(node.op, ast.Sub):
+        tgt = dotted_name(node.target)
+        return bool(tgt) and tgt.endswith(".refs")
+    return False
+
+
+# -- open/close/escape helpers ----------------------------------------------
+
+
+def _collect_opens(body: list[ast.stmt]) -> dict[str, int]:
+    """var name -> line for ``x = *.allocate(...)`` and
+    ``x = free_slots.pop()`` assignments."""
+    opens: dict[str, int] = {}
+    for s in body:
+        for node in ast.walk(s):
+            if not isinstance(node, ast.Assign) or not isinstance(node.value, ast.Call):
+                continue
+            d = dotted_name(node.value.func)
+            if not d:
+                continue
+            tail = d.rsplit(".", 1)[-1]
+            acquire = (tail == "allocate" and "allocator" in d) or (
+                tail == "pop" and "free_slots" in d
+            )
+            if acquire:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        opens[tgt.id] = node.lineno
+    return opens
+
+
+def _escaping_vars(body: list[ast.stmt], opens: dict[str, int]) -> set[str]:
+    """Vars whose value is ever transferred: returned/yielded, stored into
+    an attribute/subscript/other name, or passed to a call that is not a
+    release/safe call."""
+    escaped: set[str] = set()
+
+    def uses(expr: ast.AST, var: str) -> bool:
+        return any(isinstance(n, ast.Name) and n.id == var for n in ast.walk(expr))
+
+    for s in body:
+        for node in ast.walk(s):
+            if isinstance(node, (ast.Return, ast.Yield)) and node.value is not None:
+                escaped.update(v for v in opens if uses(node.value, v))
+            elif isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                        escaped.update(v for v in opens if uses(node.value, v))
+            elif isinstance(node, ast.AugAssign):
+                if isinstance(node.target, (ast.Attribute, ast.Subscript)):
+                    escaped.update(v for v in opens if uses(node.value, v))
+            elif isinstance(node, ast.Call):
+                d = dotted_name(node.func) or ""
+                tail = d.rsplit(".", 1)[-1]
+                if tail in _RELEASE_TAILS or tail in _SAFE_BUILTINS:
+                    continue
+                if d.split(".")[0] in _SAFE_CALL_ROOTS:
+                    continue
+                if d.rsplit(".", 1)[-1] == "allocate":
+                    continue
+                for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                    escaped.update(v for v in opens if uses(arg, v))
+    return escaped
+
+
+class _Interp:
+    """Branch-aware linear walk tracking the open set; reports resources
+    open at return/raise/fall-through. ``try`` blocks whose handlers or
+    ``finally`` release a var treat that var as protected."""
+
+    def __init__(self, tracked: dict[str, int], report) -> None:
+        self.tracked = tracked
+        self.report = report
+
+    def run(self, body: list[ast.stmt]) -> None:
+        leftover = self._block(body, set())
+        if leftover and body:
+            last = body[-1]
+            # fall-through off the end with open resources
+            if not isinstance(last, (ast.Return, ast.Raise)):
+                for var in sorted(leftover):
+                    self.report(last, var, "function exit")
+
+    def _block(self, stmts: list[ast.stmt], open_set: set[str]) -> set[str]:
+        open_set = set(open_set)
+        for s in stmts:
+            open_set = self._stmt(s, open_set)
+        return open_set
+
+    def _stmt(self, s: ast.stmt, open_set: set[str]) -> set[str]:
+        if isinstance(s, ast.Assign) and isinstance(s.value, ast.Call):
+            d = dotted_name(s.value.func) or ""
+            tail = d.rsplit(".", 1)[-1]
+            if (tail == "allocate" and "allocator" in d) or (
+                tail == "pop" and "free_slots" in d
+            ):
+                for tgt in s.targets:
+                    if isinstance(tgt, ast.Name) and tgt.id in self.tracked:
+                        open_set.add(tgt.id)
+                return open_set
+        closed = self._closes_in(s)
+        open_set -= closed
+        if isinstance(s, ast.Return):
+            for var in sorted(open_set):
+                self.report(s, var, "a return")
+            return set()
+        if isinstance(s, ast.Raise):
+            for var in sorted(open_set):
+                self.report(s, var, "a raise")
+            return set()
+        if isinstance(s, ast.If):
+            a = self._block(s.body, open_set)
+            b = self._block(s.orelse, open_set)
+            return a | b
+        if isinstance(s, (ast.For, ast.While, ast.AsyncFor)):
+            a = self._block(s.body, open_set)
+            b = self._block(s.orelse, a)
+            return b
+        if isinstance(s, (ast.With, ast.AsyncWith)):
+            return self._block(s.body, open_set)
+        if isinstance(s, ast.Try):
+            protected = set()
+            for h in s.handlers:
+                protected |= self._closes_anywhere(h.body)
+            protected |= self._closes_anywhere(s.finalbody)
+            inner = self._block(s.body, open_set - protected)
+            inner = self._block(s.orelse, inner)
+            # finally closes apply on the straight-line path too
+            inner -= self._closes_anywhere(s.finalbody)
+            return inner
+        return open_set
+
+    def _closes_in(self, s: ast.stmt) -> set[str]:
+        closed: set[str] = set()
+        for node in ast.walk(s):
+            if isinstance(node, ast.Call):
+                d = dotted_name(node.func) or ""
+                tail = d.rsplit(".", 1)[-1]
+                if tail in _RELEASE_TAILS:
+                    for arg in node.args:
+                        for n in ast.walk(arg):
+                            if isinstance(n, ast.Name) and n.id in self.tracked:
+                                closed.add(n.id)
+        return closed
+
+    def _closes_anywhere(self, stmts: list[ast.stmt]) -> set[str]:
+        closed: set[str] = set()
+        for s in stmts:
+            closed |= self._closes_in(s)
+        return closed

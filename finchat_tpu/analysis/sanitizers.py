@@ -1,0 +1,281 @@
+"""Runtime sanitizers: the dynamic teeth behind finchat-lint R1 and R3.
+
+Static rules catch the *shape* of a bug; these catch the *behavior*, wired
+into the scheduler/fleet/durability test suites by ``tests/conftest.py``:
+
+- :class:`StallSanitizer` — an instrumented event loop (asyncio debug mode
+  + ``slow_callback_duration``) that records every loop callback exceeding
+  a threshold. A test that blocks the loop — an inline device rebuild, a
+  serialize+fsync spill, a synchronous D2H fetch — fails with the exact
+  callback and duration, instead of silently stretching every sibling
+  stream's inter-token gap the way the pre-PR-8 ``_trip_breaker`` rebuild
+  did. Threshold via ``FINCHAT_STALL_THRESHOLD_S`` (default 1.0 s — the
+  historical bug class was *seconds*; CPU-test jit compiles stay under
+  it), allowlist regexes via ``FINCHAT_STALL_ALLOW`` (comma-separated).
+
+- :func:`scheduler_leak_report` — invariant audit of a STOPPED scheduler:
+  every allocator page is owned by a live shared-prefix entry (nothing
+  else may hold pages after stop), every engine slot is back on the free
+  list, every ``_PrefixEntry.refs`` equals the number of session-cache
+  entries referencing it, no in-flight prefix jobs, and the session disk
+  tier's write-behind queue is quiescent. One autouse fixture replaces
+  the bespoke per-bug regression assertions PRs 5-7 kept hand-writing
+  (``_fail_prefix_job`` slot leak, cancel-delegation page leak, drain
+  zero-leak checks).
+
+- :func:`track` / :func:`tracked_instances` — lightweight construction
+  tracking (conftest patches ``__init__``) so the fixture can find every
+  scheduler/journal a test created without threading them through
+  fixtures.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import logging
+import os
+import re
+
+_DEFAULT_THRESHOLD_S = 1.0
+
+
+class StallSanitizer:
+    """Fail-on-slow-callback instrumentation for one event loop.
+
+    Uses asyncio's own debug machinery: ``loop.set_debug(True)`` +
+    ``slow_callback_duration`` makes the loop emit ``Executing <handle>
+    took <dt> seconds`` warnings on the ``asyncio`` logger; a capturing
+    handler turns those into hard test failures. That keeps the timing
+    measurement in the loop itself (no monkeypatching of private
+    ``Handle`` internals) and inherits asyncio's coverage: callbacks,
+    task steps, and ``call_soon`` handles all route through it.
+    """
+
+    def __init__(self, threshold_s: float | None = None,
+                 allow: tuple[str, ...] = ()):
+        if threshold_s is None:
+            threshold_s = float(
+                os.environ.get("FINCHAT_STALL_THRESHOLD_S", _DEFAULT_THRESHOLD_S)
+            )
+        env_allow = tuple(
+            p for p in os.environ.get("FINCHAT_STALL_ALLOW", "").split(",") if p
+        )
+        self.threshold_s = threshold_s
+        self.allow = tuple(allow) + env_allow
+        self.stalls: list[str] = []
+        self._handler: logging.Handler | None = None
+
+    @classmethod
+    def from_env(cls) -> "StallSanitizer":
+        return cls()
+
+    def install(self, loop: asyncio.AbstractEventLoop) -> None:
+        loop.set_debug(True)
+        loop.slow_callback_duration = self.threshold_s
+        sanitizer = self
+
+        class _Capture(logging.Handler):
+            def emit(self, record: logging.LogRecord) -> None:
+                msg = record.getMessage()
+                if msg.startswith("Executing"):
+                    sanitizer.stalls.append(msg)
+
+        self._handler = _Capture(level=logging.WARNING)
+        logging.getLogger("asyncio").addHandler(self._handler)
+
+    def uninstall(self) -> None:
+        if self._handler is not None:
+            logging.getLogger("asyncio").removeHandler(self._handler)
+            self._handler = None
+
+    def violations(self) -> list[str]:
+        """Stalls not matching the allowlist."""
+        return [
+            s for s in self.stalls
+            if not any(re.search(p, s) for p in self.allow)
+        ]
+
+    def run(self, coro) -> object:
+        """``asyncio.run`` with the sanitizer installed; raises
+        ``RuntimeError`` listing violations after the coroutine finishes
+        (the test body ran to completion — the failure is the stall)."""
+        loop = asyncio.new_event_loop()
+        self.install(loop)
+        try:
+            asyncio.set_event_loop(loop)
+            result = loop.run_until_complete(coro)
+        finally:
+            # mirror asyncio.run's teardown: cancel what the test left
+            # pending (running its finally/cleanup — a failing test that
+            # never reached sched.stop() must not strand the scheduler
+            # loop task, which would both bleed threads into later tests
+            # and leave _running=True so the leak fixture skips auditing
+            # exactly the scheduler that leaked), then drain asyncgens
+            try:
+                _cancel_pending_tasks(loop)
+                loop.run_until_complete(loop.shutdown_asyncgens())
+            finally:
+                self.uninstall()
+                asyncio.set_event_loop(None)
+                loop.close()
+        bad = self.violations()
+        if bad:
+            raise RuntimeError(
+                "event-loop stall sanitizer: %d callback(s) blocked the "
+                "loop past %.2fs (finchat-lint R1 class):\n  %s"
+                % (len(bad), self.threshold_s, "\n  ".join(bad))
+            )
+        return result
+
+
+def _cancel_pending_tasks(loop: asyncio.AbstractEventLoop) -> None:
+    """asyncio.runners._cancel_all_tasks, minimally: cancel every pending
+    task and let each run its cleanup to completion."""
+    tasks = [t for t in asyncio.all_tasks(loop) if not t.done()]
+    if not tasks:
+        return
+    for t in tasks:
+        t.cancel()
+    loop.run_until_complete(asyncio.gather(*tasks, return_exceptions=True))
+    for t in tasks:
+        if t.cancelled():
+            continue
+        if t.exception() is not None:
+            loop.call_exception_handler({
+                "message": "unhandled exception during sanitizer loop shutdown",
+                "exception": t.exception(),
+                "task": t,
+            })
+
+
+# ---------------------------------------------------------------------------
+# leak sanitizer
+# ---------------------------------------------------------------------------
+
+# STRONG references, cleared by the fixture's clear_tracked() at teardown:
+# a scheduler created as a test-body local is unreferenced the moment the
+# coroutine returns, and a weak set would let GC drop exactly the leaked
+# instance before the audit runs (nondeterministic coverage). The strong
+# ref lives only from construction to the end of the owning test.
+_TRACKED: dict[str, list] = {}
+
+
+def track(kind: str, obj: object) -> None:
+    _TRACKED.setdefault(kind, []).append(obj)
+
+
+def tracked_instances(kind: str) -> list[object]:
+    return list(_TRACKED.get(kind, ()))
+
+
+def clear_tracked() -> None:
+    _TRACKED.clear()
+
+
+@contextlib.contextmanager
+def track_constructions(cls: type, kind: str):
+    """Patch ``cls.__init__`` so every construction during the context is
+    recorded under ``kind`` (strongly, until ``clear_tracked``)."""
+    orig = cls.__init__
+
+    def wrapped(self, *args, **kwargs):
+        orig(self, *args, **kwargs)
+        track(kind, self)
+
+    cls.__init__ = wrapped
+    try:
+        yield
+    finally:
+        cls.__init__ = orig
+
+
+def scheduler_leak_report(sched) -> list[str]:
+    """Invariant audit of one scheduler. Empty list = clean.
+
+    Only meaningful for a STOPPED (or never-started) scheduler — live
+    streams legitimately hold slots and pages; callers skip running ones.
+    """
+    problems: list[str] = []
+    try:
+        allocator = sched.allocator
+        engine = sched.engine
+    except AttributeError:
+        return problems  # not a real scheduler (test double)
+
+    # A plain stop() deliberately leaves live streams in place (only
+    # shutdown_drain preempts them), and unit tests drive _admit on
+    # never-started schedulers — so live handles and in-flight prefix
+    # jobs are ACCOUNTED owners, not leaks. A leak is a resource owned
+    # by NOTHING: a page whose owner died, a slot on neither the free
+    # list nor a live handle/job, a refcount with no referent.
+    live_prefix_owners = {e.owner for e in sched._prefixes}
+    live_handles = list(sched.decoding.values()) + list(sched.prefilling)
+    handle_owners = {h.seq_id for h in live_handles}
+    job_owners = {j.owner for j in sched._prefix_jobs}
+
+    owners = getattr(allocator, "_owner", {})
+    stray = {
+        owner
+        for owner in owners.values()
+        if owner not in live_prefix_owners
+        and owner not in handle_owners
+        and owner not in job_owners
+    }
+    if stray:
+        pages = [p for p, o in owners.items() if o in stray]
+        problems.append(
+            f"{len(pages)} KV page(s) leaked by dead owner(s) {sorted(stray)}"
+        )
+
+    # every slot is on the free list or held by a live handle/prefix job
+    max_seqs = engine.engine_cfg.max_seqs
+    free = len(sched.free_slots)
+    handle_slots = {h.slot for h in live_handles if h.slot >= 0}
+    in_use = len(handle_slots) + len(sched._prefix_jobs)
+    if free + in_use != max_seqs:
+        problems.append(
+            f"slot accounting broken: {free} free + {in_use} in use "
+            f"(live handles/jobs) != max_seqs {max_seqs}"
+        )
+    if len(set(sched.free_slots)) != free:
+        problems.append("duplicate slots on the free list")
+
+    # prefix-head refcounts == session entries referencing them (live
+    # handles already reported; a stopped scheduler has none)
+    session_refs: dict[int, int] = {}
+    cache = sched.session_cache
+    if cache is not None:
+        for entry in getattr(cache, "_entries", {}).values():
+            if entry.prefix_entry is not None:
+                session_refs[id(entry.prefix_entry)] = (
+                    session_refs.get(id(entry.prefix_entry), 0) + 1
+                )
+    for e in sched._prefixes:
+        expected = session_refs.get(id(e), 0) + sum(
+            1 for h in live_handles if h.prefix_entry is e
+        )
+        if e.refs != expected:
+            problems.append(
+                f"prefix entry ({e.shared_len} tokens) refs={e.refs} but "
+                f"{expected} referent(s) exist — ref leak"
+            )
+
+    # allocator's own cross-checks (double-free / free-and-owned overlap)
+    try:
+        allocator.check_invariants()
+    except AssertionError as e:
+        problems.append(f"allocator invariants: {e}")
+
+    return problems
+
+
+def close_journals() -> list[str]:
+    """Close tracked AnsweredJournal handles left open by a test (fd
+    hygiene across a 350-test suite); returns what was closed."""
+    closed = []
+    for journal in tracked_instances("journal"):
+        if getattr(journal, "_fh", None) is not None:
+            journal.close()
+            closed.append(str(getattr(journal, "path", "?")))
+    return closed

@@ -105,7 +105,7 @@ def load_llama_params(
                 # next to the already-quantized leaves
                 qt = quantize_stacked(arr)
                 # free the bf16 copy before the next tensor materializes
-                jax.block_until_ready(qt.q)
+                jax.block_until_ready(qt.q)  # finchat-lint: disable=event-loop-blocking -- checkpoint-load memory backpressure by design (one quantized slice's transients at a time); startup path, runs before anything serves
                 del arr
                 return qt
         return arr

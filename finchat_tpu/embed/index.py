@@ -394,7 +394,7 @@ class DeviceVectorIndex:
             return index
         data = np.load(vec_file)
         vectors, dates, alive = data["vectors"], data["dates"], data["alive"]
-        with open(pay_file) as f:
+        with open(pay_file) as f:  # finchat-lint: disable=event-loop-blocking -- startup snapshot load (build_app runs it before the loop serves); ingest-path saves already copy-then-write off-lock
             records = [json.loads(line) for line in f]
         if len(records) != len(vectors):
             # a crash between the two os.replace calls in save() can tear

@@ -371,6 +371,13 @@ class ContinuousBatchingScheduler:
         # breaker give-up state: True from give-up until revive() —
         # the fleet router stops routing here while set
         self.gave_up = False
+        # True while a trip-path rebuild runs in its worker thread
+        # (_trip_breaker): the rebuild replaces the page table under the
+        # engine, so register_prefix_async must not write a row into the
+        # doomed table mid-flight (the row would be lost and the head
+        # would prefill against trash pages). Best-effort contract:
+        # callers get 0 and the periodic refresh retries.
+        self._rebuilding = False
         # breaker state gauge: 0 closed, 1 open (rebuilding), 2 half-open
         # (rebuilt, awaiting the first successful probe round)
         self.metrics.set_gauge("finchat_breaker_state", 0)
@@ -635,7 +642,14 @@ class ContinuousBatchingScheduler:
             self.allocator.free(owner, pages)
             raise
         finally:
-            self.engine.reset_slot(slot)
+            try:
+                self.engine.reset_slot(slot)
+            except Exception as e:
+                # the reservation must come back even when the reset (a
+                # device op on a possibly-wedged engine) raises — an
+                # escaping raise here would skip the slot return and mask
+                # the original failure (finchat-lint R3)
+                logger.error("slot reset failed after prefix prefill: %s", e)
             self.free_slots.append(slot)
         self._prefixes.append(_PrefixEntry(ids, pages, shared_len, owner))
         logger.info("prefix cache: registered %d shared tokens (%d pages)",
@@ -679,6 +693,12 @@ class ContinuousBatchingScheduler:
         contract, same as the sync path)."""
         if not self._running:
             return self.register_prefix(prompt_ids)  # engine idle: inline
+        if self._rebuilding:
+            # the trip-path rebuild is replacing the page table in a
+            # worker thread; a row written now would be silently dropped
+            # and the head would prefill against trash pages. Best-effort:
+            # the refresh loop retries after the rebuild.
+            return 0
         prep = self._prefix_prep(prompt_ids)
         if not isinstance(prep, tuple):
             return prep
@@ -977,7 +997,18 @@ class ContinuousBatchingScheduler:
             pages = self.allocator.owned_by(handle.seq_id)
             if pages:
                 self.allocator.free(handle.seq_id, pages)
-            self.engine.reset_slot(handle.slot)
+            try:
+                self.engine.reset_slot(handle.slot)
+            except Exception as e:
+                # survivable (finchat-lint R3, the _fail_prefix_job bug
+                # class): a raising device op here would skip the slot
+                # return and the prefix-ref release below, leaking the
+                # slot forever — and _release's callers (_evict via
+                # watchdog cancel, stop) don't expect a raise. Admission
+                # rewrites the page-table row and context length anyway;
+                # a wedged device trips the breaker.
+                logger.error("slot reset failed releasing %s: %s",
+                             handle.seq_id, e)
             self.decoding.pop(handle.slot, None)
             if handle in self.prefilling:
                 self.prefilling.remove(handle)
@@ -1491,7 +1522,7 @@ class ContinuousBatchingScheduler:
                 logger.error("on_rebuild callback failed: %s", e)
         self._wakeup.set()
 
-    def _round_failed(self, scope: str, error: str) -> None:
+    async def _round_failed(self, scope: str, error: str) -> None:
         """A whole-round dispatch failure — not attributable to one
         sequence. Breaker off (``breaker_threshold`` 0): legacy behavior,
         the round's population is evicted with an error. Breaker on: the
@@ -1500,8 +1531,9 @@ class ContinuousBatchingScheduler:
         sequences are recompute-preempted and replay through admission (a
         transient blip costs a re-prefill, not the stream); at the
         threshold the breaker trips and the engine device state is
-        rebuilt. Dispatches are never re-consumed after a failure: a
-        partially-consumed step cannot be told apart from an unconsumed
+        rebuilt (async: the rebuild itself runs in a worker thread —
+        _trip_breaker). Dispatches are never re-consumed after a failure:
+        a partially-consumed step cannot be told apart from an unconsumed
         one, and replay recomputes any undelivered token anyway."""
         self.metrics.inc("finchat_dispatch_failures_total")
         if self.breaker_threshold <= 0:
@@ -1514,7 +1546,7 @@ class ContinuousBatchingScheduler:
         bucket = "prefill" if scope == "prefill" else "decode"
         self._fail_streaks[bucket] += 1
         if self._fail_streaks[bucket] >= self.breaker_threshold:
-            self._trip_breaker(bucket, error)
+            await self._trip_breaker(bucket, error)
             return
         if scope in ("prefill", "mixed"):
             for handle in list(self.prefilling):
@@ -1547,7 +1579,7 @@ class ContinuousBatchingScheduler:
                 self._breaker_tripped_at = None
                 self.metrics.set_gauge("finchat_breaker_state", 0)
 
-    def _trip_breaker(self, bucket: str, error: str) -> None:
+    async def _trip_breaker(self, bucket: str, error: str) -> None:
         """Breaker trip: preempt every live sequence to host, tear down
         and rebuild the engine's device state (weights retained, compiled
         variants still valid — shapes are unchanged), reset the page
@@ -1558,7 +1590,18 @@ class ContinuousBatchingScheduler:
         successful round closes the breaker. ``breaker_max_rebuilds``
         consecutive trips without a successful round in between give up
         and fail the in-flight streams — a persistently wedged engine
-        must not rebuild-loop forever."""
+        must not rebuild-loop forever.
+
+        The rebuild itself runs in a worker thread (the same discipline
+        as ``revive_async``): reallocating the KV pool is seconds of
+        device work at real sizes, and in a fleet every SIBLING replica
+        shares this event loop — an inline rebuild would freeze the very
+        streams the drain-on-trip just handed them (ISSUE 8 / finchat-lint
+        R1; the pre-PR-8 code did exactly that). All host bookkeeping —
+        preempts, drain, cache purge, allocator/slot resets — completes
+        BEFORE the await, so concurrent coroutines observe a consistent
+        emptied scheduler; ``_rebuilding`` gates the one seam that writes
+        device state from outside the loop (register_prefix_async)."""
         self._breaker_bucket = bucket
         self._rebuilds_without_success += 1
         if self._rebuilds_without_success > self.breaker_max_rebuilds:
@@ -1687,8 +1730,12 @@ class ContinuousBatchingScheduler:
         self._top_p[:] = 1.0
         self._top_k[:] = 0
         try:
-            with Timer(self.metrics, "finchat_engine_rebuild_seconds"):
-                self.engine.rebuild_device_state()
+            self._rebuilding = True
+            try:
+                with Timer(self.metrics, "finchat_engine_rebuild_seconds"):
+                    await asyncio.to_thread(self.engine.rebuild_device_state)
+            finally:
+                self._rebuilding = False
         except Exception as e:
             # rebuild itself failed (device gone?): fail what we hold and
             # leave the breaker open — the next trip retries the rebuild
@@ -2374,7 +2421,7 @@ class ContinuousBatchingScheduler:
             self._note_round_ok("decode")
         except Exception as e:
             logger.error("in-flight step consume error: %s", e)
-            self._round_failed("decode", str(e))
+            await self._round_failed("decode", str(e))
         return None
 
     async def _loop(self) -> None:
@@ -2457,7 +2504,7 @@ class ContinuousBatchingScheduler:
                         # dispatch — recover them together (preempt/replay
                         # under the breaker, legacy eviction without it)
                         logger.error("mixed step error: %s", e)
-                        self._round_failed("mixed", str(e))
+                        await self._round_failed("mixed", str(e))
                     await asyncio.sleep(0)  # let producers/consumers run
                     continue
 
@@ -2470,7 +2517,7 @@ class ContinuousBatchingScheduler:
                     self._note_round_ok("prefill")
                 except Exception as e:
                     logger.error("prefill round error: %s", e)
-                    self._round_failed("prefill", str(e))
+                    await self._round_failed("prefill", str(e))
 
             if (
                 self.decoding and self.spec_k > 0
@@ -2489,7 +2536,7 @@ class ContinuousBatchingScheduler:
                 except Exception as e:
                     logger.error("spec decode step error: %s", e)
                     inflight = None
-                    self._round_failed("spec", str(e))
+                    await self._round_failed("spec", str(e))
             elif self.decoding:
                 try:
                     # a grammar-constrained slot's next input comes from a
@@ -2553,7 +2600,7 @@ class ContinuousBatchingScheduler:
                     # delivered, and replay recomputes the rest anyway.
                     logger.error("decode step error: %s", e)
                     inflight = None
-                    self._round_failed("decode", str(e))
+                    await self._round_failed("decode", str(e))
             elif inflight is not None:
                 inflight = await self._drain_inflight(inflight)
 

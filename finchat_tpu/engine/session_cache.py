@@ -406,7 +406,7 @@ class SessionDiskTier:
         read-side ops that must observe prior writes). FIFO barrier: the
         single worker makes one no-op submission a full drain."""
         if self._writer is not None:
-            self._writer.submit(lambda: None).result()
+            self._writer.submit(lambda: None).result()  # finchat-lint: disable=event-loop-blocking -- FIFO barrier by contract: reached only from the SIGTERM drain (must exit fully durable) and the per-key pending-write restore gate (ROBUSTNESS §5)
 
     def close(self) -> None:
         if self._writer is not None:
@@ -477,7 +477,7 @@ class SessionDiskTier:
             if not name.endswith(self.SUFFIX):
                 continue  # quarantined or foreign file
             try:
-                with open(p, "rb") as f:
+                with open(p, "rb") as f:  # finchat-lint: disable=event-loop-blocking -- constructor-time directory sweep: runs once at process start, before the scheduler loop exists
                     head = f.read(9)
                     if head[:4] != self.MAGIC or head[4] != self.VERSION:
                         raise ValueError("bad magic/version")

@@ -82,7 +82,7 @@ class AnsweredJournal:
             return _BAD
 
     # --- write path ------------------------------------------------------
-    def append(self, message_id) -> bool:
+    def append(self, message_id) -> bool:  # finchat-lint: disable=event-loop-blocking -- fsync-BEFORE-commit IS the at-least-once contract (ROBUSTNESS §5); one ~50-byte line per answered message, journal.fsync=false is the relief valve
         """Durably record an ANSWERED id. Best-effort by contract: a
         failure (disk full, injected ``journal.append`` fault) logs and
         returns False — the answer already streamed, and refusing to
@@ -144,7 +144,7 @@ class AnsweredJournal:
         order = sorted(seen.values())[-keep:]
         return [ids[i] for i in order]
 
-    def _rewrite(self, ids: list) -> None:
+    def _rewrite(self, ids: list) -> None:  # finchat-lint: disable=event-loop-blocking -- compaction rewrites <= keep (~1024) 50-byte lines once per 8*keep appends; amortized microseconds per answer, and the fsync-before-commit ordering must hold through it
         tmp = self.path.with_suffix(".tmp")
         if self._fh is not None:
             self._fh.close()

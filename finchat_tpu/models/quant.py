@@ -125,7 +125,7 @@ def quantize_stacked(w: Array) -> QTensor:
         # cases (see init_quantized_llama_params) and would break the
         # bit-identity promised above
         part = quantize(w[i])
-        jax.block_until_ready(part.q)  # one slice's transients at a time
+        jax.block_until_ready(part.q)  # one slice's transients at a time  # finchat-lint: disable=event-loop-blocking -- deliberate per-slice sync bounding quantization transients (PR 1 satellite); startup/checkpoint path
         if q is None:
             q = jnp.zeros((L,) + part.q.shape, part.q.dtype)
             scale = jnp.zeros((L,) + part.scale.shape, part.scale.dtype)

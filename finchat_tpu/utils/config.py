@@ -142,8 +142,8 @@ class VectorConfig:
 
     url: str = ""
     api_key: str = ""
-    collection: str = TRANSACTION_COLLECTION_NAME
-    default_limit: int = 10_000  # reference qdrant_tool.py:145
+    collection: str = TRANSACTION_COLLECTION_NAME  # finchat-lint: disable=knob-consistency -- product-contract constant (reference config.py:47 keys the Qdrant collection); config-file override only, by design
+    default_limit: int = 10_000  # finchat-lint: disable=knob-consistency -- reference-parity constant (qdrant_tool.py:145); config-file override only, by design
     persist_path: str = ""  # snapshot directory; empty = in-memory only
 
     def snapshot_base(self) -> str:
@@ -469,7 +469,55 @@ def load_config(
     cfg.vector.url = _env("QDRANT_URL")
     cfg.vector.api_key = _env("QDRANT_API_KEY")
 
-    # --- env (new framework surface) ---
+    # --- env (new framework surface; every knob here is listed in the
+    # README "Configuration reference" — finchat-lint R4 enforces the
+    # three-way knob/env/README agreement) ---
+    cfg.kafka.session_timeout_ms = _env_int(
+        "FINCHAT_KAFKA_SESSION_TIMEOUT_MS", cfg.kafka.session_timeout_ms
+    )
+    cfg.kafka.client_id = _env("FINCHAT_KAFKA_CLIENT_ID", cfg.kafka.client_id)
+    cfg.kafka.auto_offset_reset = _env(
+        "FINCHAT_KAFKA_AUTO_OFFSET_RESET", cfg.kafka.auto_offset_reset
+    )
+    cfg.store.database_name = _env("FINCHAT_STORE_DB", cfg.store.database_name)
+    cfg.model.dtype = _env("FINCHAT_DTYPE", cfg.model.dtype)
+    cfg.model.seed = _env_int("FINCHAT_SEED", cfg.model.seed)
+    cfg.mesh.data = _env_int("FINCHAT_MESH_DATA", cfg.mesh.data)
+    cfg.mesh.pipe = _env_int("FINCHAT_MESH_PIPE", cfg.mesh.pipe)
+    cfg.mesh.model = _env_int("FINCHAT_MESH_MODEL", cfg.mesh.model)
+    cfg.mesh.seq = _env_int("FINCHAT_MESH_SEQ", cfg.mesh.seq)
+    cfg.mesh.expert = _env_int("FINCHAT_MESH_EXPERT", cfg.mesh.expert)
+    cfg.engine.page_size = _env_int("FINCHAT_PAGE_SIZE", cfg.engine.page_size)
+    cfg.engine.num_pages = _env_int("FINCHAT_NUM_PAGES", cfg.engine.num_pages)
+    cfg.engine.max_seq_len = _env_int("FINCHAT_MAX_SEQ_LEN", cfg.engine.max_seq_len)
+    cfg.engine.prefill_chunk = _env_int(
+        "FINCHAT_PREFILL_CHUNK", cfg.engine.prefill_chunk
+    )
+    cfg.engine.max_new_tokens = _env_int(
+        "FINCHAT_MAX_NEW_TOKENS", cfg.engine.max_new_tokens
+    )
+    cfg.engine.temperature = _env_float("FINCHAT_TEMPERATURE", cfg.engine.temperature)
+    cfg.engine.top_p = _env_float("FINCHAT_TOP_P", cfg.engine.top_p)
+    cfg.engine.top_k = _env_int("FINCHAT_TOP_K", cfg.engine.top_k)
+    cfg.engine.watchdog_seconds = _env_float(
+        "FINCHAT_WATCHDOG_SECONDS", cfg.engine.watchdog_seconds
+    )
+    cfg.engine.stream_flush_tokens = _env_int(
+        "FINCHAT_STREAM_FLUSH_TOKENS", cfg.engine.stream_flush_tokens
+    )
+    cfg.engine.edf_starvation_seconds = _env_float(
+        "FINCHAT_EDF_STARVATION_SECONDS", cfg.engine.edf_starvation_seconds
+    )
+    cfg.embed.preset = _env("FINCHAT_EMBED_PRESET", cfg.embed.preset)
+    cfg.embed.batch_size = _env_int("FINCHAT_EMBED_BATCH_SIZE", cfg.embed.batch_size)
+    cfg.fleet.respawn_backoff_seconds = _env_float(
+        "FINCHAT_FLEET_RESPAWN_BACKOFF_SECONDS", cfg.fleet.respawn_backoff_seconds
+    )
+    cfg.fleet.supervisor_interval_seconds = _env_float(
+        "FINCHAT_FLEET_SUPERVISOR_INTERVAL_SECONDS",
+        cfg.fleet.supervisor_interval_seconds,
+    )
+    cfg.serve.host = _env("FINCHAT_HOST", cfg.serve.host)
     cfg.kafka.backend = _env("FINCHAT_KAFKA_BACKEND", cfg.kafka.backend)
     cfg.kafka.commit_after_process = _env_bool(
         "FINCHAT_KAFKA_COMMIT_AFTER_PROCESS", cfg.kafka.commit_after_process
@@ -549,7 +597,7 @@ def load_config(
 
     # --- optional JSON config file ---
     if config_file:
-        with open(config_file) as f:
+        with open(config_file) as f:  # finchat-lint: disable=event-loop-blocking -- process-start config read, before any loop exists
             _apply_overrides(cfg, json.load(f))
 
     # --- explicit overrides win ---

@@ -44,6 +44,75 @@ import inspect  # noqa: E402
 
 import pytest  # noqa: E402
 
+# --- runtime sanitizers (ISSUE 8; finchat_tpu/analysis/sanitizers.py) ------
+# The scheduler/fleet/durability suites run under two sanitizers:
+# - STALL: async tests run on an asyncio-debug loop that FAILS the test
+#   when any loop callback blocks past FINCHAT_STALL_THRESHOLD_S (default
+#   1.0 s) — the dynamic form of finchat-lint R1 (the inline-rebuild /
+#   sync-spill stall class). FINCHAT_STALL_SANITIZER=0 disables.
+# - LEAK: after every test, each scheduler the test constructed and
+#   stopped is audited — allocator pages, engine slots, prefix-head
+#   refcounts, session-cache refs, in-flight prefix jobs — the dynamic
+#   form of finchat-lint R3 (the _fail_prefix_job leak class). Leftover
+#   open journal handles are closed (fd hygiene).
+SANITIZED_MODULES = {
+    "test_scheduler_pipeline",
+    "test_fleet",
+    "test_durability",
+    "test_resilience",
+    "test_session_cache",
+    "test_mixed_step",
+    "test_faults",
+    "test_decode_loop",
+    "test_prefix_cache",
+    "test_spec_decode",
+}
+
+_SANITIZERS_ON = os.environ.get("FINCHAT_STALL_SANITIZER", "1") not in ("0", "false")
+
+
+def _sanitized(module_name: str) -> bool:
+    return _SANITIZERS_ON and module_name.rsplit(".", 1)[-1] in SANITIZED_MODULES
+
+
+@pytest.fixture(autouse=True)
+def _finchat_leak_sanitizer(request):
+    """Track every scheduler/journal constructed during the test; audit
+    the stopped schedulers afterwards (analysis/sanitizers.py)."""
+    if not _sanitized(request.module.__name__):
+        yield
+        return
+    from finchat_tpu.analysis import sanitizers
+    from finchat_tpu.engine.scheduler import ContinuousBatchingScheduler
+    from finchat_tpu.io.journal import AnsweredJournal
+
+    sanitizers.clear_tracked()
+    with sanitizers.track_constructions(ContinuousBatchingScheduler, "scheduler"):
+        with sanitizers.track_constructions(AnsweredJournal, "journal"):
+            yield
+    problems: list[str] = []
+    for sched in sanitizers.tracked_instances("scheduler"):
+        task = getattr(sched, "_task", None)
+        if getattr(sched, "_running", False) and not (task and task.done()):
+            # genuinely still running (module-scoped fixture) — live
+            # streams legitimately hold slots/pages. A scheduler whose
+            # loop task was CANCELLED at loop teardown (test never called
+            # stop()) keeps _running=True but IS quiescent — audit it:
+            # the accounting invariants hold continuously, and skipping
+            # it would hide exactly the leaks of tests that forgot stop()
+            continue
+        problems += [
+            f"{type(sched).__name__}[{getattr(sched, 'replica_id', '?')}]: {p}"
+            for p in sanitizers.scheduler_leak_report(sched)
+        ]
+    sanitizers.close_journals()
+    sanitizers.clear_tracked()
+    if problems:
+        pytest.fail(
+            "leak sanitizer (finchat-lint R3 class):\n  " + "\n  ".join(problems),
+            pytrace=False,
+        )
+
 
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_between_modules():
@@ -64,10 +133,23 @@ def _clear_jax_caches_between_modules():
 @pytest.hookimpl(tryfirst=True)
 def pytest_pyfunc_call(pyfuncitem):
     """Run ``async def`` tests with asyncio.run (pytest-asyncio isn't in the
-    image)."""
+    image). Sanitized modules run on an instrumented debug loop instead:
+    any callback blocking past the threshold fails the test (the ISSUE 8
+    stall sanitizer — asyncio debug mode stays on for these suites)."""
     fn = pyfuncitem.obj
     if inspect.iscoroutinefunction(fn):
         kwargs = {name: pyfuncitem.funcargs[name] for name in pyfuncitem._fixtureinfo.argnames}
-        asyncio.run(fn(**kwargs))
+        exempt = pyfuncitem.get_closest_marker("no_stall_sanitizer") is not None
+        if _sanitized(pyfuncitem.module.__name__) and not exempt:
+            from finchat_tpu.analysis.sanitizers import StallSanitizer
+
+            try:
+                StallSanitizer.from_env().run(fn(**kwargs))
+            except RuntimeError as e:
+                if "stall sanitizer" not in str(e):
+                    raise
+                pytest.fail(str(e), pytrace=False)
+        else:
+            asyncio.run(fn(**kwargs))
         return True
     return None

@@ -319,6 +319,10 @@ def test_restore_skips_redundant_respill(tmp_path, params):
     asyncio.run(run())
 
 
+@pytest.mark.no_stall_sanitizer  # app construction + start run inline in
+# the test body as ONE loop step (cold embed-encoder compile, seconds on
+# CPU) — startup path, the same class the R1 STARTUP_ROOTS exclusion
+# blesses; nothing here exercises the serving loop the sanitizer guards
 async def test_drain_stops_fleet_supervisor_before_scheduler_drain(tmp_path):
     """The graceful drain must take the fleet supervisor down BEFORE the
     per-replica shutdown drains: a respawn's device rebuild racing
@@ -569,7 +573,7 @@ def test_shutdown_drain_straggler_zero_leaks_and_spill(tmp_path, params):
             "s2", list(range(30, 44)),
             SamplingParams(temperature=0.0, max_new_tokens=100),
         )
-        faults.arm("scheduler.decode", lambda **_: time.sleep(0.01))
+        faults.arm("scheduler.decode", lambda **_: time.sleep(0.01))  # finchat-lint: disable=event-loop-blocking -- deliberate fault payload: simulates a slow device dispatch so the drain deterministically catches a straggler
         while h.generated < 3:
             await asyncio.sleep(0.005)
         await sched.shutdown_drain()

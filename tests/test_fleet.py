@@ -323,8 +323,14 @@ def test_cancel_of_drained_handle_targets_adopter():
                                              _greedy(40))
             while h.generated < 2 or other.generated < 2:
                 await asyncio.sleep(0.002)
-            # breaker-style drain of h: preempt to host, sibling adopts
+            # breaker-style drain of h: preempt to host, sibling adopts.
+            # Mirror _drain_to_sink faithfully: the drain POPS the handle
+            # from the source's pending before offering it — leaving it
+            # there makes both schedulers race to admit the same handle
+            # (caught by the ISSUE 8 leak sanitizer: the loser strands a
+            # slot and a phantom prefilling entry on the source)
             a.scheduler._preempt(h, for_rebuild=True)
+            a.scheduler.pending.remove(h)
             b.scheduler.adopt(h)
             assert h.owner is b.scheduler
             while h.slot < 0:  # B admits the replay

@@ -336,7 +336,7 @@ async def test_watchdog_timeout_releases_slot_before_timeout_chunk():
     try:
         # ~30 ms per decode dispatch: generation cannot finish 96 tokens
         # inside the 0.4 s watchdog
-        faults.arm("scheduler.decode", lambda **_ctx: time.sleep(0.03))
+        faults.arm("scheduler.decode", lambda **_ctx: time.sleep(0.03))  # finchat-lint: disable=event-loop-blocking -- deliberate fault payload: slows decode dispatch so the watchdog fires mid-generation
         payload = {"message": "tell me everything", "conversation_id": "c1",
                    "user_id": "u9"}
         msg = Message(USER_MESSAGE_TOPIC, "c1", json.dumps(payload).encode())
