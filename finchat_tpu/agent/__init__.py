@@ -1,5 +1,9 @@
 from finchat_tpu.agent.state import AgentState, ToolCall
 from finchat_tpu.agent.graph import LLMAgent, StateGraph, END
 from finchat_tpu.agent.toolcall import parse_tool_decision
+from finchat_tpu.agent.streamparse import StreamingToolParser, ToolLauncher
 
-__all__ = ["AgentState", "ToolCall", "LLMAgent", "StateGraph", "END", "parse_tool_decision"]
+__all__ = [
+    "AgentState", "ToolCall", "LLMAgent", "StateGraph", "END",
+    "parse_tool_decision", "StreamingToolParser", "ToolLauncher",
+]

@@ -14,23 +14,40 @@ generate_response → END. Two execution paths, both preserved (SURVEY §2.5):
 The two LLM roles of the reference (tool-decision vs response,
 llm_agent.py:34-45) become two TextGenerators — typically the same TPU
 engine with different prompts and sampling.
+
+The tool-streaming plane (ISSUE 9; agent/streamparse.py) makes node 1 a
+streaming consumer of the decision decode: tools launch at argument
+commit points and the response prefix hold is taken at name-commit, so
+multi-tool turns cost ~max(decode, tool) instead of decode + tool.
 """
 
 from __future__ import annotations
 
+import asyncio
 from collections import deque
 from dataclasses import replace as dc_replace
 from datetime import date
 from typing import Any, AsyncGenerator, Awaitable, Callable
 
 from finchat_tpu.agent.state import AgentState, ToolCall
+from finchat_tpu.agent.streamparse import (
+    ArgComplete,
+    CallComplete,
+    ParseAnomaly,
+    StreamingToolParser,
+    ToolLauncher,
+    ToolNameComplete,
+    ToolResult,
+)
 from finchat_tpu.agent.toolcall import parse_tool_decision
 from finchat_tpu.engine.generator import TextGenerator
 from finchat_tpu.engine.sampler import SamplingParams
 from finchat_tpu.engine.session_cache import session_key
 from finchat_tpu.io.schemas import ChatMessage
 from finchat_tpu.models.tokenizer import render_chat
+from finchat_tpu.utils.faults import inject
 from finchat_tpu.utils.logging import get_logger
+from finchat_tpu.utils.metrics import METRICS
 
 logger = get_logger(__name__)
 
@@ -91,6 +108,8 @@ class LLMAgent:
         response_sampling: SamplingParams | None = None,
         today: Callable[[], str] = lambda: date.today().isoformat(),
         retrieval_overlap: bool = True,
+        tool_streaming: bool = True,
+        metrics=None,
     ):
         self.tool_generator = tool_generator
         self.response_generator = response_generator
@@ -113,6 +132,20 @@ class LLMAgent:
         # arrives. Needs a generator exposing the partial-prefill seam
         # (EngineGenerator); anything else silently uses the serial path.
         self.retrieval_overlap = retrieval_overlap
+        # tool-streaming plane (ISSUE 9; agent/streamparse.py): consume the
+        # decision decode as a chunk stream, launch the tool at its commit
+        # points, and take the response-prefix hold at name-commit — a
+        # whole decode earlier than the retrieve-node overlap alone. Falls
+        # back to decode-then-parse semantics on any parser anomaly.
+        self.tool_streaming = tool_streaming
+        # metrics view for the finchat_tool_* family: a fleet replica's
+        # agent emits through its engine's labeled scheduler view (the
+        # same replica label every per-engine family rides); explicit
+        # ``metrics`` wins, stub generators fall back to the global
+        # registry
+        self.metrics = metrics if metrics is not None else getattr(
+            getattr(tool_generator, "scheduler", None), "metrics", None
+        ) or METRICS
         self.graph = self._build_graph()
         logger.info("Agent initialized with state graph")
 
@@ -262,20 +295,132 @@ class LLMAgent:
 
     # --- nodes -----------------------------------------------------------
     async def _decide_retrieval_node(self, state: AgentState) -> AgentState:
-        """Node 1: decide whether transaction retrieval is needed."""
+        """Node 1: decide whether transaction retrieval is needed.
+
+        With ``tool_streaming`` on, the decision decode is consumed as a
+        chunk stream (ISSUE 9): the incremental parser emits commit-point
+        events as the tool name and each argument finish decoding, the
+        ToolLauncher speculatively executes the call while the remaining
+        tokens still decode, and the response prompt's static prefix
+        starts prefilling at name-commit via the hold-park-graft seam —
+        a whole decision decode earlier than the retrieve-node overlap
+        alone. The authoritative decision is ALWAYS the serial parser
+        over the full text (streamparse.finish), so the streamed and
+        serial paths agree byte-for-byte on WHAT to do; streaming only
+        moves WHEN the tool and the prefix prefill start.
+        """
         logger.info("Deciding if transaction retrieval is needed")
-        decision_text = await self.tool_generator.generate(
-            self._tool_prompt_text(state), self.tool_sampling,
-            conversation_id=self._session_key(state, "tool"),
-            deadline=state.deadline,
+        if not self.tool_streaming:
+            decision_text = await self.tool_generator.generate(
+                self._tool_prompt_text(state), self.tool_sampling,
+                conversation_id=self._session_key(state, "tool"),
+                deadline=state.deadline,
+            )
+            tool_call = parse_tool_decision(decision_text)
+            if tool_call is not None:
+                state.tool_calls.append(tool_call)
+                logger.info("LLM requested retrieval with args: %s", tool_call.args)
+            else:
+                logger.info("LLM decided no retrieval needed")
+            return state
+
+        parser = StreamingToolParser()
+        launcher = ToolLauncher(
+            lambda call: self._execute_streamed(state, call),
+            refine=self._refine_tool_result, metrics=self.metrics,
         )
-        tool_call = parse_tool_decision(decision_text)
+        prefix_task: Any = None
+        try:
+            async for chunk in self.tool_generator.stream(
+                self._tool_prompt_text(state), self.tool_sampling,
+                conversation_id=self._session_key(state, "tool"),
+                deadline=state.deadline,
+            ):
+                for event in parser.feed(chunk):
+                    if isinstance(event, ParseAnomaly):
+                        # off-grammar output: the eager plane disengages;
+                        # the serial parse below still decides (counted
+                        # once per turn after finish, which can also flag
+                        # an incremental/serial mismatch)
+                        launcher.abandon()
+                    elif isinstance(event, ToolNameComplete):
+                        if prefix_task is None and self._overlap_ready(state):
+                            prefix_task = asyncio.create_task(self._begin_prefix(state))
+                    elif isinstance(event, CallComplete):
+                        launcher.update(event.call)
+                    elif isinstance(event, ArgComplete):
+                        launcher.update(parser.launchable_call())
+        except BaseException:
+            # stream failure / cancellation: no adoption will happen, and
+            # an early prefix hold must not pin its slot and pages
+            launcher.abandon()
+            await self._settle_prefix(state, prefix_task, keep=False)
+            raise
+        launcher.mark_decode_done()
+        tool_call = parser.finish()
+        if parser.anomaly is not None:
+            launcher.abandon()  # no-op unless finish() flagged a mismatch
+            self.metrics.inc("finchat_tool_fallbacks_total")
         if tool_call is not None:
             state.tool_calls.append(tool_call)
+            state.tool_stream = launcher
+            if prefix_task is None and self._overlap_ready(state):
+                # anomaly paths can reach a call without a name-commit
+                # event (parse_tool_decision's named-without-args rescue);
+                # take the hold now so retrieve_data still overlaps
+                prefix_task = asyncio.create_task(self._begin_prefix(state))
+            await self._settle_prefix(state, prefix_task, keep=True)
             logger.info("LLM requested retrieval with args: %s", tool_call.args)
         else:
+            launcher.abandon()
+            await self._settle_prefix(state, prefix_task, keep=False)
             logger.info("LLM decided no retrieval needed")
         return state
+
+    def _overlap_ready(self, state: AgentState) -> bool:
+        return (
+            self.retrieval_overlap
+            and state.partial_prefill is None
+            and hasattr(self.response_generator, "begin_partial")
+        )
+
+    async def _begin_prefix(self, state: AgentState):
+        try:
+            return await self.response_generator.begin_partial(
+                self._response_prefix_text(state), self.response_sampling,
+                conversation_id=self._session_key(state, "resp"),
+                deadline=state.deadline,
+            )
+        except Exception as e:  # overlap is an optimization, never fatal
+            logger.warning("partial prefill unavailable, serial path: %s", e)
+            return None
+
+    async def _settle_prefix(self, state: AgentState, prefix_task, *, keep: bool) -> None:
+        """Resolve an early static-prefix prefill task into
+        ``state.partial_prefill`` (keep=True), or release the hold it may
+        have taken (keep=False — no-tool turn, upstream error): a hold
+        nobody will graft must give back its slot and pages."""
+        if prefix_task is None:
+            return
+        if not keep:
+            prefix_task.cancel()
+        try:
+            handle = await prefix_task
+        except asyncio.CancelledError:
+            # keep=True never cancels the task itself, so a CancelledError
+            # here is the CALLER being cancelled (asyncio cancels the
+            # awaited task on the way) — propagate, don't swallow the
+            # turn's cancellation; a hold whose submit still lands is the
+            # scheduler TTL reap's to reclaim. keep=False cancelled the
+            # task deliberately: that CancelledError is ours to swallow.
+            if keep:
+                raise
+            return
+        except Exception:
+            return  # _begin_prefix already logged; overlap is optional
+        state.partial_prefill = handle
+        if not keep:
+            self._release_partial(state)
 
     async def _retrieve_data_node(self, state: AgentState) -> AgentState:
         """Node 2: execute the tool. Only the first queued call is honored
@@ -291,12 +436,34 @@ class LLMAgent:
         logger.info("Retrieving transaction data")
         if not state.tool_calls:
             return state
-        import asyncio
-
         tool_call = state.tool_calls.popleft()
         tool_args = dict(tool_call.args)
         tool_args["user_id"] = state.user_id  # server-side injection, never model-chosen
-        if self.retrieval_overlap and hasattr(self.response_generator, "begin_partial"):
+        launcher, state.tool_stream = state.tool_stream, None
+        if launcher is not None:
+            # tool-streaming plane: the call is (typically) already in
+            # flight since its arguments committed mid-decode, and the
+            # prefix hold was taken at name-commit — adopt the result.
+            # Any failure degrades to the serial path below (the
+            # launcher's error is structured and retryable by contract).
+            try:
+                self._apply_tool_result(
+                    state, await launcher.result_for(tool_call)
+                )
+                return state
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                logger.warning(
+                    "streamed tool execution failed (code=%s); serial retry: %s",
+                    getattr(e, "code", None), e,
+                )
+                self.metrics.inc("finchat_tool_fallbacks_total")
+        if (
+            self.retrieval_overlap
+            and state.partial_prefill is None
+            and hasattr(self.response_generator, "begin_partial")
+        ):
             # overlap: the tool (embed + search + graft assembly) runs as a
             # task while the response prompt's static prefix submits for
             # prefill — by the time retrieval returns, the scheduler has
@@ -304,15 +471,7 @@ class LLMAgent:
             # the retrieved block + user turn remain to prefill
             retrieval = asyncio.create_task(self._run_tool(state, tool_call, tool_args))
             try:
-                try:
-                    state.partial_prefill = await self.response_generator.begin_partial(
-                        self._response_prefix_text(state), self.response_sampling,
-                        conversation_id=self._session_key(state, "resp"),
-                        deadline=state.deadline,
-                    )
-                except Exception as e:  # overlap is an optimization, never fatal
-                    logger.warning("partial prefill unavailable, serial path: %s", e)
-                    state.partial_prefill = None
+                state.partial_prefill = await self._begin_prefix(state)
                 await retrieval
             except BaseException:
                 # cancellation (client disconnect, watchdog) must not orphan
@@ -330,31 +489,70 @@ class LLMAgent:
     async def _run_tool(self, state: AgentState, tool_call: ToolCall,
                         tool_args: dict[str, Any]) -> None:
         try:
-            if tool_call.name == "create_financial_plot" and hasattr(self.retriever, "structured"):
-                rows = await self.retriever.structured(tool_args)
-                state.retrieved_transactions = [r["page_content"] for r in rows]
-                chartable = [r for r in rows if "amount" in r]
-                if chartable:
-                    import json as _json
-
-                    from finchat_tpu.tools.plot import PlotConfig, create_financial_plot
-
-                    # synchronous by design: the render is cheap (Agg, ≤10k
-                    # rows) and matplotlib off the main thread has segfaulted
-                    # the worker (see tools/plot.py)
-                    state.plot_data_uri = create_financial_plot(
-                        _json.dumps(chartable),
-                        # chart_type/title are guaranteed by _validate_plot_args
-                        PlotConfig(chart_type=tool_args["chart_type"], title=tool_args["title"]),
-                    )
-                else:
-                    logger.warning("plot requested but no rows carry an 'amount' field")
-            else:
-                state.retrieved_transactions = await self.retriever(tool_args)
-            logger.info("Retrieved %d transactions", len(state.retrieved_transactions))
+            self._apply_tool_result(
+                state, await self._execute_tool(state, tool_call, tool_args)
+            )
         except Exception as e:
             logger.error("Error running tool: %s", e)
             state.retrieved_transactions = [f"Error: {e}"]
+
+    async def _execute_streamed(self, state: AgentState, call: ToolCall) -> ToolResult:
+        """The ToolLauncher's execute seam: same server-side user_id
+        injection as the serial path — the launcher only ever sees
+        validated model args, never an identity it could influence."""
+        args = dict(call.args)
+        args["user_id"] = state.user_id  # server-side injection, never model-chosen
+        return await self._execute_tool(state, call, args)
+
+    async def _execute_tool(self, state: AgentState, tool_call: ToolCall,
+                            tool_args: dict[str, Any]) -> ToolResult:
+        """One tool execution → ToolResult. Deliberately mutation-free:
+        the speculative plane runs this inside a cancellable task, and
+        only an ADOPTED result may touch agent state (``_apply_tool_result``).
+        ``tool.execute`` is the fault site (utils/faults.py) for both the
+        streamed and serial planes."""
+        inject("tool.execute", tool=tool_call.name, user_id=state.user_id)
+        if tool_call.name == "create_financial_plot" and hasattr(self.retriever, "structured"):
+            rows = await self.retriever.structured(tool_args)
+            texts = [r["page_content"] for r in rows]
+            chartable = [r for r in rows if "amount" in r]
+            plot_data_uri = None
+            if chartable:
+                import json as _json
+
+                from finchat_tpu.tools.plot import PlotConfig, create_financial_plot
+
+                # synchronous by design: the render is cheap (Agg, ≤10k
+                # rows) and matplotlib off the main thread has segfaulted
+                # the worker (see tools/plot.py)
+                plot_data_uri = create_financial_plot(
+                    _json.dumps(chartable),
+                    # chart_type/title are guaranteed by _validate_plot_args
+                    PlotConfig(chart_type=tool_args["chart_type"], title=tool_args["title"]),
+                )
+            else:
+                logger.warning("plot requested but no rows carry an 'amount' field")
+            return ToolResult(texts, plot_data_uri)
+        return ToolResult(await self.retriever(tool_args))
+
+    @staticmethod
+    def _refine_tool_result(result: ToolResult, call: ToolCall) -> ToolResult:
+        """Host-side refinement for late-committed REFINE_KEYS
+        (streamparse): ``num_transactions`` is a pure top-k cut, and the
+        retriever returns score-ordered rows, so slicing the speculative
+        superset equals a limit-n query (exact on the in-tree index;
+        an approximate-ANN backend could drift on score ties — the
+        documented speculation trade)."""
+        n = call.args.get("num_transactions")
+        if isinstance(n, int) and len(result.texts) > n:
+            return ToolResult(result.texts[:n], result.plot_data_uri)
+        return result
+
+    def _apply_tool_result(self, state: AgentState, result: ToolResult) -> None:
+        state.retrieved_transactions = result.texts
+        if result.plot_data_uri is not None:
+            state.plot_data_uri = result.plot_data_uri
+        logger.info("Retrieved %d transactions", len(state.retrieved_transactions))
 
     def _response_kwargs(self, state: AgentState) -> dict[str, Any]:
         """Generation kwargs for the response role. ``partial`` is only
@@ -376,6 +574,13 @@ class LLMAgent:
         ):
             self.response_generator.release_partial(state.partial_prefill)
         state.partial_prefill = None
+
+    def _cancel_tool_stream(self, state: AgentState) -> None:
+        """Leak guard: a speculative launch nobody adopted (error or
+        abandonment upstream of retrieve_data) must not keep running."""
+        if state.tool_stream is not None:
+            state.tool_stream.abandon()
+            state.tool_stream = None
 
     async def _generate_response_node(self, state: AgentState) -> AgentState:
         """Node 3: generate the final response (non-streaming graph path)."""
@@ -418,6 +623,7 @@ class LLMAgent:
         try:
             final_state = await self.graph.ainvoke(state)
         finally:
+            self._cancel_tool_stream(state)
             self._release_partial(state)
         return {
             "response": final_state.final_response,
@@ -477,7 +683,9 @@ class LLMAgent:
                     yield {"type": "response_chunk", "content": chunk}
         finally:
             # a hold the stream never claimed (consumer abandoned the
-            # generator, an upstream error) must not pin its slot/pages
+            # generator, an upstream error) must not pin its slot/pages,
+            # and an unadopted speculative tool launch must not keep running
+            self._cancel_tool_stream(state)
             self._release_partial(state)
 
         yield {"type": "complete", "message": "Query processing completed"}

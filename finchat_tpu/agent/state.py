@@ -37,6 +37,10 @@ class AgentState:
     # the response prompt's static prefix (generator.begin_partial handle),
     # taken while retrieval runs and grafted at generation time
     partial_prefill: Any = None
+    # tool-streaming plane (agent/streamparse.py): the ToolLauncher whose
+    # speculative execution started during the decision decode, adopted
+    # (or cancelled) by retrieve_data; None outside a streamed tool turn
+    tool_stream: Any = None
     # per-request completion deadline (monotonic time.perf_counter; None =
     # none), threaded serve/app → agent → generator → scheduler for the
     # shed/EDF admission plane (ROBUSTNESS.md)

@@ -1,0 +1,516 @@
+"""Tool-streaming plane: incremental tool-call parsing and eager tool
+execution DURING the decision decode (ISSUE 9; ROADMAP item 3).
+
+PR 3 overlapped retrieval with the *response prefix prefill*, but the agent
+still fully decoded the tool-call turn (grammar-constrained, up to 96
+tokens) before ``parse_tool_decision`` fired a single tool — every tool
+turn paid decode + tool serially. Following Conveyor (PAPERS.md: "Efficient
+Tool-aware LLM Serving with Tool Partial Execution"), this module parses
+the partially decoded output as chunks arrive and launches the tool the
+moment enough of the call has *committed*:
+
+- :class:`StreamingToolParser` — an event-emitting character state machine
+  run in lockstep with the SAME grammar DFA the constrained sampler uses
+  (``agent/constrained.py`` ``build_tool_grammar``), so "is this stream
+  still a well-formed tool call" is answered by the exact automaton that
+  constrained the decode. Events: ``ToolNameComplete`` (the ``(`` after
+  the name), ``ArgComplete(key, value)`` (the arg's *closing delimiter*
+  decoded — the commit point: a string's closing quote, an int's
+  terminator), ``CallComplete`` (the closing ``)``), ``NoToolComplete``,
+  and ``ParseAnomaly`` (the stream left the grammar — streaming disengages
+  and the serial parser decides).
+- :class:`ToolLauncher` — speculative execution manager. It launches the
+  tool as soon as the name and every *launch-required* argument have
+  committed, relaunches (cancelling the stale task — a counted
+  speculative cancel) when a later token commits an argument that
+  invalidates the in-flight launch, and adopts the task at
+  ``result_for`` when it matches the authoritative final call.
+
+AUTHORITY CONTRACT: the streaming plane is latency-only. The final
+decision is ALWAYS ``parse_tool_decision`` over the accumulated text
+(:meth:`StreamingToolParser.finish`), byte-identical to the serial
+decode-then-parse path by construction regardless of how the text was
+chunked into decode bursts (the split-point invariance fuzz test pins
+this). Off-grammar output — impossible under the constrained sampler,
+routine from a stub — merely forfeits the eager launch.
+
+Metrics (``finchat_tool_*`` family; emitted through the launcher's
+``metrics`` view so fleet replicas label them per replica like every
+per-engine family): ``finchat_tool_launches_total``,
+``finchat_tool_speculative_cancels_total``,
+``finchat_tool_fallbacks_total`` (streaming disengaged — anomaly,
+mismatch, or a failed speculative execution retried serially), and the
+``finchat_tool_overlap_saved_seconds`` histogram (tool time hidden under
+the remainder of decode per adopted launch).
+
+Fault site: ``tool.execute`` (utils/faults.py) fires inside every tool
+execution — speculative and serial — so tests can drive the
+fail-speculative → retry-serial degradation.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass
+from typing import Any, Awaitable, Callable
+
+from finchat_tpu.agent.constrained import DEAD, CharDFA, build_tool_grammar
+from finchat_tpu.agent.state import ToolCall
+from finchat_tpu.agent.toolcall import (
+    NO_TOOL_LITERAL,
+    PLOT_TOOL_NAME,
+    TOOL_NAME,
+    VALIDATORS,
+    parse_tool_decision,
+)
+from finchat_tpu.utils.logging import get_logger
+from finchat_tpu.utils.metrics import METRICS
+
+logger = get_logger(__name__)
+
+# Arguments that must have COMMITTED before a speculative launch is
+# worthwhile: the search query is the embed input — launching before it
+# closes would embed a default query the final call almost never uses,
+# while the remaining args (limits, date window, chart cosmetics) have
+# stable validated defaults that a later commit merely refines (a counted
+# relaunch). Tools absent here never launch before CallComplete.
+LAUNCH_KEYS: dict[str, tuple[str, ...]] = {
+    TOOL_NAME: ("search_query",),
+    PLOT_TOOL_NAME: ("search_query",),
+}
+
+# Keys whose LATE commit does not invalidate an in-flight speculative
+# launch: the adopter refines the speculative superset host-side instead
+# of relaunching (Conveyor's partial-execution move, adapted to the
+# retrieval schema). ``num_transactions`` is a pure top-k cut — the index
+# returns score-ordered rows, so speculative-top-default[:n] equals a
+# limit-n query on any retriever with a deterministic score order (the
+# in-tree device index; an approximate-ANN backend could drift on score
+# ties, which is the documented speculation trade there). Keys that
+# change WHICH rows score (``search_query``, ``time_period_days``'s
+# device-side date filter, plot cosmetics baked into the render) are
+# absent: their late commit cancels and relaunches.
+REFINE_KEYS: dict[str, tuple[str, ...]] = {
+    TOOL_NAME: ("num_transactions",),
+    PLOT_TOOL_NAME: (),
+}
+
+
+def refinable(base: ToolCall, final: ToolCall) -> bool:
+    """May ``final`` be served by refining ``base``'s (possibly in-flight)
+    speculative result? Same tool, and every differing key is a declared
+    refine key that TIGHTENS: the adopter can slice a speculative
+    superset down, never grow it — so the key must be absent from
+    ``base`` (the launch fetched with the generous default) or its base
+    value must already cover the final one. Duplicate-key decodes (the
+    grammar doesn't track used keys) make the grow direction reachable."""
+    if base.name != final.name:
+        return False
+    allowed = REFINE_KEYS.get(final.name, ())
+    for key in set(base.args) | set(final.args):
+        b, f = base.args.get(key), final.args.get(key)
+        if b == f:
+            continue
+        if key not in allowed:
+            return False
+        if key in base.args and not (
+            isinstance(b, int) and isinstance(f, int) and b >= f
+        ):
+            return False
+    return True
+
+_WS = " \t\n"
+
+
+# --- parse events ---------------------------------------------------------
+
+@dataclass(frozen=True)
+class ToolNameComplete:
+    name: str
+
+
+@dataclass(frozen=True)
+class ArgComplete:
+    key: str
+    value: Any  # decoded raw value (str or int), pre-validation
+
+
+@dataclass(frozen=True)
+class CallComplete:
+    call: ToolCall  # validated
+
+
+@dataclass(frozen=True)
+class NoToolComplete:
+    pass
+
+
+@dataclass(frozen=True)
+class ParseAnomaly:
+    reason: str
+
+
+@dataclass
+class ToolResult:
+    """What one tool execution produced — returned (not written to agent
+    state) so the speculative plane can discard an unadopted run."""
+
+    texts: list[str]
+    plot_data_uri: str | None = None
+
+
+class ToolStreamError(RuntimeError):
+    """Speculative tool execution failed. ``code``/``retryable`` mirror
+    the scheduler's structured error contract (generator.GenerationError,
+    io/schemas error_chunk) so the serving layer can emit a structured
+    retryable chunk if the serial retry also fails; the agent's first
+    recourse is always the serial-path retry."""
+
+    def __init__(self, message: str, *, code: str | None = None,
+                 retryable: bool = False):
+        super().__init__(message)
+        self.code = code
+        self.retryable = retryable
+
+
+# --- incremental parser ---------------------------------------------------
+
+_GRAMMAR_DFA: CharDFA | None = None
+
+
+def _tool_dfa() -> CharDFA:
+    """Process-wide grammar DFA shared with the constrained sampler's
+    machinery (a duplicate build under a racing first call is harmless —
+    the char-level automaton is cheap, unlike GrammarVocab's vocab scan)."""
+    global _GRAMMAR_DFA
+    if _GRAMMAR_DFA is None:
+        _GRAMMAR_DFA = build_tool_grammar()
+    return _GRAMMAR_DFA
+
+
+class StreamingToolParser:
+    """Incremental tool-decision parser over decode chunks.
+
+    ``feed(chunk)`` processes character-by-character (so the event stream
+    is invariant to HOW the text was chunked — decode bursts, per-token
+    SSE flushes, mid-JSON-string splits) and returns the events the chunk
+    completed. Two automata run in lockstep per char:
+
+    - the shared grammar DFA (``build_tool_grammar``) answers membership:
+      the first off-grammar char raises ``ParseAnomaly`` and permanently
+      disengages the semantic scanner (the serial parser still decides at
+      ``finish``);
+    - a semantic scanner — trusting the DFA for structure — tracks which
+      production the char advances (name, key, string/int value) and
+      emits commit-point events.
+    """
+
+    def __init__(self) -> None:
+        self._dfa = _tool_dfa()
+        self._dfa_state = self._dfa.start
+        self._chunks: list[str] = []
+        self.anomaly: str | None = None
+        self.completed_call: ToolCall | None = None
+        self.no_tool = False
+        # semantic scanner state
+        self._mode = "lead"
+        self._buf: list[str] = []
+        self._key = ""
+        self._name: str | None = None
+        self._raw_args: dict[str, Any] = {}
+
+    @property
+    def text(self) -> str:
+        return "".join(self._chunks)
+
+    # -- public API --------------------------------------------------------
+
+    def feed(self, chunk: str) -> list[Any]:
+        self._chunks.append(chunk)
+        if self.anomaly is not None:
+            return []
+        events: list[Any] = []
+        for ch in chunk:
+            nxt = self._dfa.step(self._dfa_state, ch)
+            if nxt == DEAD:
+                self.anomaly = "stream left the tool-call grammar"
+                events.append(ParseAnomaly(self.anomaly))
+                break
+            self._dfa_state = nxt
+            produced = self._scan(ch)
+            if produced:
+                events.extend(produced)
+        return events
+
+    def launchable_call(self) -> ToolCall | None:
+        """The call the launcher may speculatively run RIGHT NOW: name
+        committed and every launch-required argument committed (closing
+        delimiter decoded). Args are the validated view of the committed
+        subset — a later commit may invalidate (the launcher's problem)."""
+        if self.anomaly is not None or self._name is None:
+            return None
+        required = LAUNCH_KEYS.get(self._name)
+        if required is None or any(k not in self._raw_args for k in required):
+            return None
+        return ToolCall(name=self._name, args=VALIDATORS[self._name](dict(self._raw_args)))
+
+    def finish(self) -> ToolCall | None:
+        """Authoritative final decision: ALWAYS the serial parser over the
+        accumulated text — byte-identical to the decode-then-parse path by
+        construction. A disagreement with the incremental ``CallComplete``
+        (reachable only through a scanner bug) is logged, flagged as an
+        anomaly (so callers count the fallback and drop the speculative
+        result), and the serial result wins."""
+        final = parse_tool_decision(self.text)
+        if self.anomaly is None and self.completed_call is not None and (
+            final is None or final != self.completed_call
+        ):
+            logger.warning(
+                "incremental parse disagrees with serial parse (%r vs %r); serial wins",
+                self.completed_call, final,
+            )
+            self.anomaly = "incremental/serial parse mismatch"
+        return final
+
+    # -- semantic scanner --------------------------------------------------
+    # Only grammatical chars reach here (the DFA stepped first), so each
+    # mode needs to recognize exactly the transitions the grammar allows
+    # from it; anything unrecognized is structural whitespace.
+
+    def _scan(self, ch: str) -> list[Any]:
+        mode = self._mode
+        if mode == "lead":
+            if not self._buf and ch in _WS:
+                return []  # bounded leading whitespace
+            self._buf.append(ch)
+            if ch == "(":
+                self._name = "".join(self._buf[:-1])
+                self._buf = []
+                self._mode = "pre_obj"
+                return [ToolNameComplete(self._name)]
+            if "".join(self._buf) == NO_TOOL_LITERAL:
+                self.no_tool = True
+                self._buf = []
+                self._mode = "done"
+                return [NoToolComplete()]
+            return []
+        if mode == "pre_obj":
+            if ch == "{":
+                self._mode = "obj"
+            return []
+        if mode in ("obj", "pre_key"):
+            if ch == '"':
+                self._buf = []
+                self._mode = "key"
+            elif ch == "}":  # empty object or (grammar forbids it) post-comma
+                self._mode = "post_obj"
+            return []
+        if mode == "key":
+            if ch == '"':
+                self._key = "".join(self._buf)
+                self._buf = []
+                self._mode = "post_key"
+            else:
+                self._buf.append(ch)
+            return []
+        if mode == "post_key":
+            if ch == ":":
+                self._mode = "pre_val"
+            return []
+        if mode == "pre_val":
+            if ch == '"':
+                self._buf = []
+                self._mode = "str_val"
+            elif ch.isdigit():
+                self._buf = [ch]
+                self._mode = "int_val"
+            return []
+        if mode == "str_val":
+            if ch == '"':  # commit point: the closing quote
+                value = "".join(self._buf)
+                self._buf = []
+                self._mode = "post_val"
+                return self._commit_arg(value)
+            self._buf.append(ch)
+            return []
+        if mode == "int_val":
+            if ch.isdigit():
+                self._buf.append(ch)
+                return []
+            # commit point: an int has no closing char — its terminator
+            # ("," / "}" / whitespace) commits it AND advances the object
+            value = int("".join(self._buf))
+            self._buf = []
+            if ch == ",":
+                self._mode = "pre_key"
+            elif ch == "}":
+                self._mode = "post_obj"
+            else:
+                self._mode = "post_val"
+            return self._commit_arg(value)
+        if mode == "post_val":
+            if ch == ",":
+                self._mode = "pre_key"
+            elif ch == "}":
+                self._mode = "post_obj"
+            return []
+        if mode == "post_obj":
+            if ch == ")":
+                self._mode = "done"
+                return self._complete_call()
+            return []
+        return []  # "done": the DFA rejects any further char (→ anomaly)
+
+    def _commit_arg(self, value: Any) -> list[Any]:
+        self._raw_args[self._key] = value  # duplicate keys: last one wins, like json.loads
+        return [ArgComplete(self._key, value)]
+
+    def _complete_call(self) -> list[Any]:
+        assert self._name is not None  # "(" was seen to get here
+        self.completed_call = ToolCall(
+            name=self._name, args=VALIDATORS[self._name](dict(self._raw_args))
+        )
+        return [CallComplete(self.completed_call)]
+
+
+# --- speculative launcher -------------------------------------------------
+
+def _swallow(task: asyncio.Task) -> None:
+    # a cancelled/failed speculative launch nobody adopted must not log
+    # "Task exception was never retrieved"
+    if not task.cancelled():
+        task.exception()
+
+
+class ToolLauncher:
+    """Speculative tool-execution manager for one decision decode.
+
+    ``execute`` is an async callable ``ToolCall -> ToolResult`` (the agent
+    binds server-side user_id injection into it — the launcher never sees
+    an identity the model could have influenced beyond validated args).
+
+    Lifecycle: ``update(call)`` per commit event (launch / keep / cancel+
+    relaunch), ``mark_decode_done()`` when the decode stream ends,
+    ``result_for(final_call)`` to adopt or re-run, ``abandon()`` when
+    nothing will be adopted (anomaly, no-tool turn, upstream error).
+    """
+
+    def __init__(
+        self,
+        execute: Callable[[ToolCall], Awaitable[ToolResult]],
+        *,
+        refine: Callable[[ToolResult, ToolCall], ToolResult] | None = None,
+        metrics=None,
+    ):
+        self._execute = execute
+        # host-side refinement for late-committed REFINE_KEYS (e.g. the
+        # top-k slice); None = exact-match adoption only
+        self._refine = refine
+        self.metrics = metrics if metrics is not None else METRICS
+        self._task: asyncio.Task | None = None
+        self._task_call: ToolCall | None = None
+        self._task_started = 0.0
+        self._decode_done_at: float | None = None
+        self.abandoned = False
+
+    def update(self, call: ToolCall | None) -> None:
+        """Reconcile the in-flight launch with the call the committed
+        stream implies right now. A call the in-flight launch can still
+        serve (identical, or differing only in refine keys) keeps it; a
+        genuinely invalidated launch is cancelled (the counted
+        speculative cancel — a later token invalidated an eagerly-
+        launched argument) and relaunched."""
+        if self.abandoned or call is None:
+            return
+        if self._task is not None:
+            if self._task_call == call or (
+                self._refine is not None and refinable(self._task_call, call)
+            ):
+                return
+            self._drop_task(cancelled_speculation=True)
+        self._launch(call)
+
+    def mark_decode_done(self) -> None:
+        """The decision decode finished — the boundary the overlap-saved
+        histogram measures against (serial would only START the tool now)."""
+        self._decode_done_at = time.perf_counter()
+
+    def abandon(self) -> None:
+        """Cancel any in-flight launch; no adoption will happen."""
+        self.abandoned = True
+        self._drop_task(cancelled_speculation=True)
+
+    async def result_for(self, call: ToolCall) -> ToolResult:
+        """Adopt the in-flight launch when it can serve the authoritative
+        final ``call`` — identical args, or differing only in refine keys
+        (the result is then refined host-side); otherwise cancel it and
+        run ``call`` through the same execute seam. Failures raise
+        :class:`ToolStreamError` (structured, retryable) for the caller's
+        serial fallback."""
+        adoptable = (
+            not self.abandoned
+            and self._task is not None
+            and (self._task_call == call
+                 or (self._refine is not None
+                     and refinable(self._task_call, call)))
+        )
+        if not adoptable:
+            self._drop_task(cancelled_speculation=True)
+            self.abandoned = False
+            self._launch(call)
+        task = self._task
+        task_call = self._task_call
+        started = self._task_started
+        assert task is not None and task_call is not None
+        self._task, self._task_call = None, None  # ownership transfers here
+        try:
+            result, ended = await task
+        except asyncio.CancelledError:
+            if task.cancelled():  # the task's own cancellation, not ours
+                raise ToolStreamError(
+                    "speculative tool launch was cancelled",
+                    code="tool_execute_cancelled", retryable=True,
+                ) from None
+            task.cancel()  # we are being cancelled: don't orphan the tool
+            raise
+        except Exception as e:
+            raise ToolStreamError(
+                f"tool execution failed: {e}",
+                code="tool_execute_failed", retryable=True,
+            ) from e
+        if self._decode_done_at is not None:
+            # the slice of the adopted run that hid under decode — the
+            # latency a serial decide→execute turn would have paid on top
+            saved = max(0.0, min(ended, self._decode_done_at) - started)
+            self.metrics.observe("finchat_tool_overlap_saved_seconds", saved)
+        if task_call != call:
+            assert self._refine is not None  # adoptable implies it
+            result = self._refine(result, call)
+        return result
+
+    # -- internals ---------------------------------------------------------
+
+    def _launch(self, call: ToolCall) -> None:
+        self._task_call = call
+        self._task_started = time.perf_counter()
+        self._task = asyncio.ensure_future(self._timed(call))
+        self._task.add_done_callback(_swallow)
+        self.metrics.inc("finchat_tool_launches_total")
+
+    async def _timed(self, call: ToolCall) -> tuple[ToolResult, float]:
+        # completion is stamped INSIDE the task: adoption may happen long
+        # after the tool finished, and the overlap-saved histogram must
+        # measure the tool run, not the adoption latency
+        result = await self._execute(call)
+        return result, time.perf_counter()
+
+    def _drop_task(self, cancelled_speculation: bool) -> None:
+        task = self._task
+        self._task, self._task_call = None, None
+        if task is None:
+            return
+        if not task.done():
+            task.cancel()
+        if cancelled_speculation:
+            self.metrics.inc("finchat_tool_speculative_cancels_total")

@@ -70,7 +70,10 @@ def _validate_plot_args(raw: dict) -> dict:
     return args
 
 
-_VALIDATORS = {
+# public: the streaming parser (agent/streamparse.py) validates per-arg
+# commits through the SAME validators, so eager launches and the serial
+# parse can never disagree on defaulting/clamping rules
+VALIDATORS = {
     TOOL_NAME: _validate_retrieval_args,
     PLOT_TOOL_NAME: _validate_plot_args,
 }
@@ -88,11 +91,11 @@ def parse_tool_decision(text: str) -> ToolCall | None:
             if name in stripped:
                 # named a tool but args are malformed → call with defaults
                 logger.warning("tool call named without parsable args: %r", stripped[:120])
-                return ToolCall(name=name, args=_VALIDATORS[name]({}))
+                return ToolCall(name=name, args=VALIDATORS[name]({}))
         return None
 
     name = match.group(1)
-    validator = _VALIDATORS[name]
+    validator = VALIDATORS[name]
     try:
         raw = json.loads(match.group(2))
     except json.JSONDecodeError:

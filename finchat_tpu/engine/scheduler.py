@@ -346,6 +346,12 @@ class ContinuousBatchingScheduler:
         self.preemption_enabled = bool(cfg.preemption)
         self.edf_starvation_s = max(0.0, cfg.edf_starvation_seconds)
         self.max_queue_depth = max(0, cfg.max_queue_depth)
+        # retrieval/prefill overlap (ISSUE 3): how long a parked hold may
+        # wait for its extend_prompt before the scheduler reclaims its
+        # slot+pages — retrieval is ms-scale (and the tool-streaming
+        # plane takes holds at most one decision decode early), so a hold
+        # this old means its owner died. engine.partial_hold_ttl_seconds.
+        self.hold_ttl_s = max(0.0, cfg.partial_hold_ttl_seconds)
         self._fail_streaks = {"prefill": 0, "decode": 0}
         self._rebuilds_without_success = 0
         self._breaker_tripped_at: float | None = None
@@ -488,11 +494,6 @@ class ContinuousBatchingScheduler:
         self._wakeup.set()
         return handle
 
-    # retrieval/prefill overlap (ISSUE 3): how long a parked hold may wait
-    # for its extend_prompt before the scheduler reclaims its slot+pages —
-    # retrieval is ms-scale, so a hold this old means its owner died
-    HOLD_TTL_S = 30.0
-
     async def submit_partial(
         self,
         seq_id: str,
@@ -528,7 +529,7 @@ class ContinuousBatchingScheduler:
         # scheduler loop is a separate task), so the hold flags are set
         # before admission can see the handle
         handle.held = True
-        handle.held_deadline = time.perf_counter() + self.HOLD_TTL_S
+        handle.held_deadline = time.perf_counter() + self.hold_ttl_s
         self.metrics.inc("finchat_partial_holds_total")
         return handle
 
@@ -604,7 +605,7 @@ class ContinuousBatchingScheduler:
             if handle.held and now > handle.held_deadline:
                 logger.warning(
                     "partial hold %s expired after %.0fs without extend_prompt; "
-                    "reclaiming its slot and pages", handle.seq_id, self.HOLD_TTL_S,
+                    "reclaiming its slot and pages", handle.seq_id, self.hold_ttl_s,
                 )
                 self.metrics.inc("finchat_partial_stale_reaps_total")
                 self._evict(handle, "error", error="partial hold expired")

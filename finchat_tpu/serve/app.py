@@ -1236,6 +1236,11 @@ def build_app(cfg: AppConfig | None = None, *, store: ConversationStore | None =
                 top_k=cfg.engine.top_k, max_new_tokens=cfg.engine.max_new_tokens,
             ),
             retrieval_overlap=cfg.engine.retrieval_overlap,
+            # tool-streaming plane (ISSUE 9): eager tool launch + early
+            # prefix hold during the decision decode; the agent derives
+            # its finchat_tool_* metrics view from the generator's
+            # scheduler, so fleet replicas label the family per replica
+            tool_streaming=cfg.engine.tool_streaming,
         )
 
     agent = make_agent(tool_generator, response_generator)

@@ -263,6 +263,19 @@ class EngineConfig:
     # retrieved block when it arrives; falls back to the serial path
     # whenever the graft would invalidate already-prefilled KV
     retrieval_overlap: bool = True
+    # parked-hold TTL for the overlap path's hold-park-graft seam: how
+    # long a submit_partial hold may wait for its extend_prompt before
+    # the scheduler reclaims its slot and pages (the owner died).
+    # Retrieval is ms-scale and the tool-streaming plane takes holds at
+    # most one decision decode early, so the default has huge margin.
+    partial_hold_ttl_seconds: float = 30.0
+    # tool-streaming plane (agent/streamparse.py — ISSUE 9): parse the
+    # tool-decision decode incrementally and launch retrieval/plot
+    # execution the moment the tool name and each required argument
+    # commit, overlapping tool latency with the remainder of decode and
+    # with the response-prefix prefill (taken at name-commit). Falls
+    # back byte-identically to decode-then-parse on any parser anomaly.
+    tool_streaming: bool = True
     # unified mixed prefill+decode step (engine mixed_step): one ragged
     # [rows, chunk] device dispatch per scheduler iteration advances every
     # prefilling row one chunk AND every decoding row one token (decode
@@ -570,6 +583,12 @@ def load_config(
     cfg.kafka.offsets_dir = _env("FINCHAT_KAFKA_OFFSETS_DIR", cfg.kafka.offsets_dir)
     cfg.engine.retrieval_overlap = _env_bool(
         "FINCHAT_RETRIEVAL_OVERLAP", cfg.engine.retrieval_overlap
+    )
+    cfg.engine.partial_hold_ttl_seconds = _env_float(
+        "FINCHAT_PARTIAL_HOLD_TTL_SECONDS", cfg.engine.partial_hold_ttl_seconds
+    )
+    cfg.engine.tool_streaming = _env_bool(
+        "FINCHAT_TOOL_STREAMING", cfg.engine.tool_streaming
     )
     cfg.engine.mixed_step = _env_bool("FINCHAT_MIXED_STEP", cfg.engine.mixed_step)
     cfg.engine.compilation_cache_dir = _env(

@@ -12,6 +12,10 @@ that raise, delay, or drop to drive the degradation contracts:
   QoS, kafka_client.py:26-27), error chunks are flushed;
 - retrieval failure: the answer is still generated with the Error marker
   (llm_agent.py:129-131).
+- tool-streaming plane (ISSUE 9): ``tool.execute`` fires inside every
+  tool execution — speculative and serial (``agent/graph.py
+  _execute_tool``) — so a test can fail an eagerly-launched tool
+  mid-decode and assert the structured-retryable serial fallback;
 - durability plane (ISSUE 7): ``disk.spill`` (a failed session-record
   write never fails the retiring stream), ``disk.restore`` (a failed /
   corrupt record read quarantines the file and cold-starts the

@@ -108,6 +108,19 @@ started), ``finchat_partial_grafts_total`` (extend_prompt grafted the
 full prompt onto a hold), ``finchat_partial_fallbacks_total`` (graft
 would have invalidated prefilled KV — serial fallback), and
 ``finchat_partial_stale_reaps_total`` (abandoned holds reclaimed).
+
+Tool-streaming family (agent/streamparse.py — ISSUE 9; per engine/replica
+via the agent's labeled view like every per-engine family):
+``finchat_tool_launches_total`` (speculative + adopted tool executions
+dispatched by the launcher), ``finchat_tool_speculative_cancels_total``
+(in-flight launches cancelled because a later token committed an
+argument that invalidated them, or adoption mismatched),
+``finchat_tool_fallbacks_total`` (streaming disengaged for a turn —
+parser anomaly, incremental/serial mismatch, or a failed speculative
+execution retried on the serial path), and the
+``finchat_tool_overlap_saved_seconds`` histogram (per adopted launch,
+the slice of tool execution that ran under the remainder of the
+decision decode — the latency a serial decide→execute turn pays on top).
 """
 
 from __future__ import annotations

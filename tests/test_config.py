@@ -82,3 +82,17 @@ def test_mixed_step_and_compilation_cache_env_readers(monkeypatch):
     cfg = load_config()
     assert cfg.engine.mixed_step is False
     assert cfg.engine.compilation_cache_dir == "/tmp/finchat-xla-cache"
+
+
+def test_tool_streaming_and_hold_ttl_env_readers(monkeypatch):
+    from finchat_tpu.utils.config import load_config
+
+    cfg = load_config()
+    assert cfg.engine.tool_streaming is True  # default on (ISSUE 9)
+    assert cfg.engine.partial_hold_ttl_seconds == 30.0  # legacy HOLD_TTL_S
+
+    monkeypatch.setenv("FINCHAT_TOOL_STREAMING", "0")
+    monkeypatch.setenv("FINCHAT_PARTIAL_HOLD_TTL_SECONDS", "2.5")
+    cfg = load_config()
+    assert cfg.engine.tool_streaming is False
+    assert cfg.engine.partial_hold_ttl_seconds == 2.5

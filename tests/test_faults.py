@@ -124,7 +124,10 @@ def test_transient_decode_fault_legacy_eviction_with_breaker_off():
 
 def test_retrieval_fault_degrades_to_error_marker():
     """Retrieval raising degrades per the reference contract
-    (llm_agent.py:129-131): Error marker in context, answer still made."""
+    (llm_agent.py:129-131): Error marker in context, answer still made.
+    Pinned on the serial path (tool_streaming=False) — the streamed path
+    RETRIES a failed speculative launch serially before degrading
+    (tests/test_tool_streaming.py covers that contract)."""
     from finchat_tpu.agent.graph import LLMAgent
     from finchat_tpu.engine.generator import StubGenerator
 
@@ -137,7 +140,7 @@ def test_retrieval_fault_degrades_to_error_marker():
     agent = LLMAgent(
         StubGenerator(default='retrieve_transactions({"search_query": "x"})'),
         StubGenerator(default="Here's what I can say without your data."),
-        FaultyRetriever(), "sys", "tool",
+        FaultyRetriever(), "sys", "tool", tool_streaming=False,
     )
     result = asyncio.run(agent.query("what did I spend?", "u1"))
     assert result["response"].startswith("Here's")
