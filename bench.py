@@ -129,6 +129,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mixed-smoke", action="store_true",
                    help="tiny --mixed-sweep variant for CI: fewer "
                         "episodes, fusion+identity gates only")
+    p.add_argument("--ragged-sweep", action="store_true",
+                   help="CPU-runnable benchmark of the packed ragged step "
+                        "(ISSUE 10): spec decode, decode_loop fused tails, a "
+                        "grammar-constrained stream, and a short-tail long "
+                        "prompt coexisting — previously ALL demoted to the "
+                        "split path. Reports model dispatches per "
+                        "coexist-iteration (>=2 split -> ~1 ragged), "
+                        "per-dispatch feature coverage, byte-identity, "
+                        "warmup-variant collapse, and a zero-leak audit")
+    p.add_argument("--ragged-smoke", action="store_true",
+                   help="tiny --ragged-sweep variant for CI: fewer episodes, "
+                        "shorter prompts")
     p.add_argument("--chaos-sweep", action="store_true",
                    help="CPU-runnable chaos benchmark of the resilience "
                         "plane (ISSUE 5): greedy streams under injected "
@@ -233,6 +245,8 @@ def run_worker(args: argparse.Namespace) -> int:
             smoke=args.chaos_smoke,
             rates=tuple(float(r) for r in args.chaos_rates.split(",")),
         )
+    elif args.ragged_sweep or args.ragged_smoke:
+        result = measure_ragged_sweep(smoke=args.ragged_smoke)
     elif args.mixed_sweep:
         result = measure_mixed_sweep(smoke=args.mixed_smoke)
     elif args.tool_overlap_sweep or args.tool_overlap_smoke:
@@ -1435,6 +1449,246 @@ def measure_mixed_sweep(smoke: bool = False) -> dict:
     }
 
 
+def measure_ragged_sweep(smoke: bool = False) -> dict:
+    """Benchmark the packed ragged step's demotion erasure (ISSUE 10),
+    CPU-runnable through the REAL scheduler.
+
+    Workload — the exact feature mix that demoted EVERY coexist iteration
+    under PR 4's padded mixed step: spec decode on (a repetitive greedy
+    stream whose prompt-lookup proposals fire), decode_loop on (fused
+    K-token tails), a grammar-constrained stream, and a long prompt with a
+    short tail admitted mid-decode. Each episode's window runs from the
+    long prompt's submission to its first token, entered only once the
+    spec stream has a LIVE proposal window (so the coexist iterations
+    actually carry spec verify rows). Measured once with
+    ``engine.mixed_step`` off (split path: a prefill round plus a
+    spec/loop/decode dispatch per iteration — >= 2 dispatches) and once on
+    (ONE packed ragged dispatch):
+
+    - model dispatches per coexist-iteration at the engine dispatch seams
+      — the >=2 → ~1 headline with every previously-demoting feature live;
+    - per-dispatch feature coverage (spec rows, fused tails, constrained
+      slots, short-tail prefill rows riding the SAME dispatch);
+    - greedy/constrained byte-identity of every stream across the modes;
+    - compiled-warmup-variant counts (the collapsed row×chunk×mode
+      matrix), and a zero-leak audit of the stopped scheduler
+      (analysis/sanitizers.scheduler_leak_report).
+
+    The identity check runs at fp32 for the same reason as
+    measure_mixed_sweep: pin the math identity so a structural bug cannot
+    hide behind bf16 near-tie rounding.
+    """
+    import asyncio
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from finchat_tpu.agent.constrained import GrammarVocab, TokenConstraint
+    from finchat_tpu.analysis.sanitizers import scheduler_leak_report
+    from finchat_tpu.engine.engine import InferenceEngine
+    from finchat_tpu.engine.kv_cache import pages_needed
+    from finchat_tpu.engine.sampler import SamplingParams
+    from finchat_tpu.engine.scheduler import ContinuousBatchingScheduler
+    from finchat_tpu.models.llama import PRESETS, init_params
+    from finchat_tpu.models.tokenizer import ByteTokenizer
+    from finchat_tpu.utils.config import EngineConfig
+    from finchat_tpu.utils.metrics import METRICS
+
+    config = dataclasses.replace(PRESETS["mini"], dtype=jnp.float32)
+    page_size = 16
+    chunk = 32
+    long_chunks = 4 if smoke else 6
+    long_len = chunk * long_chunks + 3  # short tail: a ragged 3-token row
+    spec_budget = 40 if smoke else 56
+    episodes = 1 if smoke else 2  # measured episodes (plus one warm one)
+    max_seq_len = long_len + 4 * page_size
+    pps = pages_needed(max_seq_len, page_size)
+    tok = ByteTokenizer()
+    rng = np.random.default_rng(0)
+    base = rng.integers(1, config.vocab_size, size=4).tolist()
+    spec_prompt = (base * 6)[:20]
+    by_prompt = rng.integers(1, config.vocab_size, size=11).tolist()
+    tool_prompt = tok.encode("decide", add_bos=True)
+    long_prompt = rng.integers(1, config.vocab_size, size=long_len).tolist()
+    window_keys = (
+        "finchat_prefill_seconds_count",
+        "finchat_decode_dispatches_total",
+        "finchat_mixed_dispatches_total",
+        "finchat_coexist_iterations_total",
+        "finchat_coexist_dispatches_total",
+    )
+
+    def run(mixed: bool) -> dict:
+        ecfg = EngineConfig(
+            max_seqs=6, page_size=page_size, num_pages=6 * pps + 8,
+            max_seq_len=max_seq_len, prefill_chunk=chunk, mixed_step=mixed,
+            session_cache=False, spec_tokens=3, decode_loop_depth=3,
+        )
+        engine = InferenceEngine(config, init_params(config, jax.random.key(0)), ecfg)
+        engine.warmup()  # compiles excluded from every episode's window
+        sched = ContinuousBatchingScheduler(engine, eos_id=-1)
+        features: list = []
+        if mixed:
+            real = engine.ragged_mixed
+
+            def spy(tokens, tok_row, row_slot, row_start, row_len,
+                    row_from_device, row_arm, row_n_drafts, *rest):
+                rl = np.asarray(row_len)
+                fd = np.asarray(row_from_device)
+                features.append({
+                    "prefill": bool(((rl > 0) & ~fd).any()),
+                    "spec": bool((np.asarray(row_n_drafts) > 0).any()),
+                    "loop": bool(np.asarray(rest[3]).any()),
+                    "constrained": any(
+                        h.constraint is not None for h in sched.decoding.values()
+                    ),
+                    "short_tail": bool(((rl > 0) & ~fd & (rl < chunk)).any()),
+                })
+                return real(tokens, tok_row, row_slot, row_start, row_len,
+                            row_from_device, row_arm, row_n_drafts, *rest)
+
+            engine.ragged_mixed = spy
+        win = {k: 0.0 for k in window_keys}
+
+        async def drain(handle, out):
+            while True:
+                ev = await handle.events.get()
+                if ev["type"] == "token":
+                    out.append(ev["token_id"])
+                elif ev["type"] == "done":
+                    return
+                else:
+                    raise RuntimeError(str(ev))
+
+        async def go():
+            all_streams = []
+            await sched.start()
+            try:
+                for ep in range(episodes + 1):  # episode 0 warms steady state
+                    hs = await sched.submit(
+                        f"spec{ep}", spec_prompt,
+                        SamplingParams(temperature=0.0, max_new_tokens=spec_budget))
+                    hb = await sched.submit(
+                        f"by{ep}", by_prompt,
+                        SamplingParams(temperature=0.0, max_new_tokens=spec_budget - 8))
+                    hc = await sched.submit(
+                        f"tool{ep}", tool_prompt,
+                        SamplingParams(temperature=0.0, max_new_tokens=24),
+                        constraint=TokenConstraint(GrammarVocab.for_tokenizer(tok)),
+                    )
+                    outs = {"spec": [], "by": [], "tool": [], "long": []}
+                    tasks = [asyncio.create_task(drain(hs, outs["spec"])),
+                             asyncio.create_task(drain(hb, outs["by"])),
+                             asyncio.create_task(drain(hc, outs["tool"]))]
+                    # admit the long prompt inside a live proposal window
+                    # (timing only; greedy token values are unaffected)
+                    for _ in range(30_000):
+                        if hs.finished or (
+                            sched._spec_cooldown == 0
+                            and hs.ngram_index is not None
+                            and hs.ngram_index.propose(2)
+                        ):
+                            break
+                        await asyncio.sleep(0.001)
+                    snap0 = METRICS.snapshot()
+                    hl = await sched.submit(
+                        f"long{ep}", long_prompt,
+                        SamplingParams(temperature=0.0, max_new_tokens=4))
+                    ltask = asyncio.create_task(drain(hl, outs["long"]))
+                    for _ in range(300_000):  # bounded: a drain error must
+                        if outs["long"] or hl.finished:  # fail, not hang
+                            break
+                        await asyncio.sleep(0.001)
+                    await asyncio.gather(*tasks, ltask)
+                    # snapshot AFTER the episode fully drains: the
+                    # scheduler attributes a coexist iteration's
+                    # dispatches at the NEXT iteration's start, so the
+                    # exact numerator needs the post-episode tick
+                    await asyncio.sleep(0.05)
+                    snap1 = METRICS.snapshot()
+                    if ep == 0:
+                        continue
+                    for k in window_keys:
+                        win[k] += snap1.get(k, 0) - snap0.get(k, 0)
+                    all_streams.append({k: list(v) for k, v in outs.items()})
+                return all_streams
+            finally:
+                await sched.stop()
+
+        streams = asyncio.run(go())
+        leaks = scheduler_leak_report(sched)
+        iters = max(win["finchat_coexist_iterations_total"], 1.0)
+        # exact attribution: only dispatches booked to coexist iterations
+        # (the scheduler's mark/attribute pair), immune to pure-decode
+        # iterations straddling the window
+        dispatches = win["finchat_coexist_dispatches_total"]
+        return {
+            "streams": streams,
+            "dpi": dispatches / iters,
+            "window": {k: int(v) for k, v in win.items()},
+            "features": features,
+            "leaks": leaks,
+            "warmup_variants": engine.compiled_variants,
+            "ragged_buckets": engine.ragged_token_buckets() if mixed else [],
+        }
+
+    split = run(False)
+    ragged = run(True)
+
+    feats = ragged["features"]
+    all_in_one = sum(
+        1 for f in feats
+        if f["prefill"] and f["spec"] and f["loop"] and f["constrained"]
+    )
+    # the padded-mixed warmup matrix this PR collapses: pow-2 row buckets
+    # × two chunk buckets (PR 4), vs the single packed-token bucket axis
+    from finchat_tpu.engine.engine import round_up_pow2
+
+    row_buckets = round_up_pow2(6).bit_length()  # 1..round_up_pow2(max_seqs)
+    padded_matrix = row_buckets * 2
+    print(f"[bench] ragged sweep: dispatches/coexist-iteration "
+          f"{split['dpi']:.2f} split -> {ragged['dpi']:.2f} ragged with "
+          f"spec+loop+constrained live ({all_in_one}/{len(feats)} fused "
+          f"dispatches carried all features); warmup mixed-family variants "
+          f"{padded_matrix} (padded row x chunk matrix) -> "
+          f"{len(ragged['ragged_buckets'])} (packed-token buckets)",
+          file=sys.stderr, flush=True)
+
+    return {
+        "metric": "ragged_sweep",
+        "unit": "dispatches/coexist-iteration",
+        "smoke": smoke,
+        "model": "mini (fp32 — see identity note in measure_ragged_sweep)",
+        "prefill_chunk": chunk,
+        "long_prompt_chunks": long_chunks,
+        "episodes": episodes,
+        "spec_tokens": 3,
+        "decode_loop_depth": 3,
+        "dispatches_per_iteration_split": round(split["dpi"], 3),
+        "dispatches_per_iteration_ragged": round(ragged["dpi"], 3),
+        "window_split": split["window"],
+        "window_ragged": ragged["window"],
+        "fused_dispatches": len(feats),
+        "fused_with_spec": sum(1 for f in feats if f["spec"]),
+        "fused_with_loop_tail": sum(1 for f in feats if f["loop"]),
+        "fused_with_constrained": sum(1 for f in feats if f["constrained"]),
+        "fused_with_short_tail": sum(1 for f in feats if f["short_tail"]),
+        "fused_with_all_features": all_in_one,
+        "greedy_outputs_identical": ragged["streams"] == split["streams"],
+        "zero_leaks": not split["leaks"] and not ragged["leaks"],
+        "leak_report": split["leaks"] + ragged["leaks"],
+        "warmup_variants_split": split["warmup_variants"],
+        "warmup_variants_ragged": ragged["warmup_variants"],
+        "padded_mixed_matrix_variants": padded_matrix,
+        "ragged_bucket_variants": len(ragged["ragged_buckets"]),
+        "warmup_matrix_collapsed": len(ragged["ragged_buckets"]) < padded_matrix,
+        "platform": jax.devices()[0].platform,
+        "device": str(jax.devices()[0]),
+    }
+
+
 def measure_chaos_sweep(smoke: bool = False, rates: tuple = (0.05, 0.2)) -> dict:
     """Chaos benchmark of the resilience plane (ISSUE 5), CPU-runnable
     through the REAL scheduler on the tiny fp32 config (fp32 pins greedy
@@ -2244,6 +2498,9 @@ def spawn_worker(args: argparse.Namespace, platform: str, timeout: float) -> dic
         cmd += ["--mixed-sweep"]
         if args.mixed_smoke:
             cmd += ["--mixed-smoke"]
+    if args.ragged_sweep or args.ragged_smoke:
+        cmd += (["--ragged-smoke"] if args.ragged_smoke
+                else ["--ragged-sweep"])
     if args.tool_overlap_sweep or args.tool_overlap_smoke:
         cmd += (["--tool-overlap-smoke"] if args.tool_overlap_smoke
                 else ["--tool-overlap-sweep"])

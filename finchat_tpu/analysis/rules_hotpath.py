@@ -58,7 +58,7 @@ _OFF_LOOP_TAILS = ("to_thread", "run_in_executor", "submit")
 SCHEDULER_HOT = {
     "_dispatch_decode",
     "_dispatch_decode_loop",
-    "_mixed_round",
+    "_ragged_round",
     "_prefill_round",
     "_run_spec_step",
     "_consume_step",

@@ -17,13 +17,17 @@ point has ONE static shape per (batch-bucket) —
   decode iterations per dispatch (on-device sampling + per-slot EOS mask
   inside a ``fori_loop``) — the host pays one dispatch and one
   ``[K, max_seqs]`` token fetch per K tokens instead of per token.
-- ``mixed_step``: ONE ragged ``[rows, chunk]`` dispatch advancing every
-  prefilling sequence a chunk AND every decoding slot a token together —
-  decode rows are length-1 rows of the same batch, reading their input
-  token and position from device state, with on-device sampling for
-  decode rows and completing prefill rows. The scheduler's mixed path
-  (engine.mixed_step config, default on) cuts a coexisting iteration from
-  two serialized model dispatches to one.
+- ``ragged_mixed_step``: ONE packed ragged dispatch advancing every
+  prefilling sequence a chunk, every decoding slot a token, every
+  spec-decode slot a (1+Kd)-token verify block, and every loop-eligible
+  slot a fused K-token tail — rows of a PACKED token buffer
+  (ops/ragged_paged_attention.py), each carrying its own length, page
+  list, and sampling params, with on-device sampling preserved
+  throughout. The scheduler's mixed path (engine.mixed_step config,
+  default on) cuts a coexisting iteration from two-or-more serialized
+  model dispatches to one, with no per-mode demotions (ISSUE 10; PR 4's
+  padded ``[rows, chunk]`` buffer demoted on spec/loop/constrained work
+  and paid dense decode-row compute per padded column).
 
 State is donated on every call and the KV cache is updated IN PLACE by the
 Pallas append kernel (ops/kv_append.py) on the decode path — XLA's scatter
@@ -512,102 +516,260 @@ def decode_step(
     return new_state, next_tokens, (step_logits if return_logits else None)
 
 
-@partial(jax.jit, static_argnames=("config", "page_size", "attn_backend"), donate_argnums=(1,))
-def mixed_step(
+def _ragged_attention_fn(
+    page_rows: Array,  # [R, max_pages] per-ROW page lists (host-gathered)
+    tok_row: Array,  # [T] int32 — owning row per packed token (R = padding)
+    tok_pos: Array,  # [T] int32 — absolute position per packed token
+    row_kv_len: Array,  # [R] int32 — valid KV per row incl. this dispatch
+    tok_valid: Array,  # [T] bool — real token (False = buffer padding)
+    page_size: int,
+    n_kv: int,
+    attn_backend: str,
+):
+    """Attention callback for the packed ragged step (``ragged_mixed_step``):
+    per-token KV writes through the chunk scatter (one full-cache copy per
+    round, amortized over every row — the mixed-step trade), then the ragged
+    paged kernel (ops/ragged_paged_attention.py) reads each row's pages in
+    place. The ``jax.lax`` reference backend computes each packed token as
+    its own batch element of the SAME ``gather_kv`` + ``mha_reference`` math
+    the split path uses — the fp32 byte-identity contract's foundation."""
+    from finchat_tpu.ops.dispatch import ragged_paged_attention
+
+    R = page_rows.shape[0]
+    safe_row = jnp.minimum(tok_row, R - 1)
+    # per-token page rows for the scatter; padding tokens write the trash
+    # page (n_valid 0 redirects them inside the scatter)
+    pt_tok = page_rows[safe_row]  # [T, max_pages]
+    n_valid_tok = tok_valid.astype(jnp.int32)
+
+    def attention(q: Array, k: Array, v: Array, cache: Any, layer_idx: Array):
+        from finchat_tpu.utils.tracing import named_scope
+
+        k_pages, v_pages, k_scales, v_scales = cache
+        quantized = k_pages.dtype == jnp.int8  # static under trace
+        T = k.shape[1]
+        layer = layer_idx.reshape(1)
+        with named_scope("kv_scatter_ragged"):
+            # each packed token is one (B=T, C=1) scatter row at its own
+            # absolute position through its own page list
+            k_pages, v_pages, k_scales, v_scales = _scatter_kv(
+                (k_pages, v_pages, k_scales, v_scales),
+                k.reshape(T, 1, n_kv, -1), v.reshape(T, 1, n_kv, -1),
+                pt_tok, tok_pos, n_valid_tok, page_size, layer_idx, n_kv,
+            )
+        with named_scope("ragged_paged_attention"):
+            out = ragged_paged_attention(
+                q[0], k_pages, v_pages, page_rows, tok_row, tok_pos,
+                row_kv_len, layer, page_size=page_size, n_kv=n_kv,
+                backend=attn_backend,
+                k_scales=k_scales if quantized else None,
+                v_scales=v_scales if quantized else None,
+            )
+        return out[None], (k_pages, v_pages, k_scales, v_scales)
+
+    return attention
+
+
+@partial(
+    jax.jit,
+    static_argnames=("config", "page_size", "attn_backend", "spec_width",
+                     "loop_depth"),
+    donate_argnums=(1,),
+)
+def ragged_mixed_step(
     params: dict[str, Any],
     state: DecodeState,
-    tokens: Array,  # [N, C] — prefill rows' chunk tokens (decode rows ignored)
-    slots: Array,  # [N] int32
-    start_pos: Array,  # [N] int32 — prefill rows (decode rows read context_lens)
-    n_valid: Array,  # [N] int32 — chunk len per prefill row, 1 per decode row, 0 pad
-    is_decode: Array,  # [N] bool — input token + start position come from device state
-    arm: Array,  # [N] bool — sample a next token and arm the slot's last_tokens
-    temperature: Array,  # [N] — PER-ROW sampling params (host-gathered by slot)
-    top_p: Array,  # [N]
-    top_k: Array,  # [N] int32
+    tokens: Array,  # [T] int32 PACKED token buffer (0 at device-read positions)
+    tok_row: Array,  # [T] int32 — owning row, ascending contiguous (R = padding)
+    row_slot: Array,  # [R] int32 — engine slot per row
+    row_start: Array,  # [R] int32 — abs pos of the row's first token (prefill)
+    row_len: Array,  # [R] int32 — tokens in the row (0 = padding row)
+    row_from_device: Array,  # [R] bool — token 0 reads last_tokens[slot] and the
+    #   row starts at context_lens[slot] (decode rows, spec verify rows)
+    row_arm: Array,  # [R] bool — commit this row's sampled token to last_tokens
+    row_n_drafts: Array,  # [R] int32 — spec rows: row_len == 1 + n_drafts
+    temperature: Array,  # [R] — PER-ROW sampling params
+    top_p: Array,  # [R]
+    top_k: Array,  # [R] int32
+    loop_active: Array,  # [max_seqs] bool — slots riding the fused K-token tail
+    loop_temperature: Array,  # [max_seqs] — per-SLOT params for the tail
+    loop_top_p: Array,  # [max_seqs]
+    loop_top_k: Array,  # [max_seqs] int32
+    eos_id: Array,  # scalar int32 (< 0 disables the tail's stop mask)
     *,
     config: LlamaConfig,
     page_size: int,
     attn_backend: str = "ref",
-) -> tuple[DecodeState, Array, Array]:
-    """ONE ragged dispatch advancing prefill chunks AND decode tokens
-    together (the scheduler's mixed path, ISSUE 4): rows are either a
-    prefill chunk (``n_valid`` up to C) or a single decode token
-    (``is_decode``, ``n_valid = 1``) of the same ``[N, C]`` batch, so a
-    scheduler iteration with both populations pays one weights-read and
-    one dispatch boundary instead of a serialized prefill round plus a
-    decode step (Ragged Paged Attention / Kernel Looping, PAPERS.md).
-    Returns (state, next_tokens [N], last-valid-token logits [N, vocab]).
+    spec_width: int = 0,
+    loop_depth: int = 1,
+) -> tuple[DecodeState, Array, Array, Array, Array]:
+    """ONE packed ragged dispatch advancing every serving population at once
+    (the scheduler's mixed path, ISSUE 10 — built on
+    ops/ragged_paged_attention.py): prefill chunks of any length, 1-token
+    decode rows, grammar-constrained rows (host overrides via the returned
+    logits), and (1+Kd)-token spec verify rows are rows of ONE packed
+    buffer; loop-eligible decode slots then free-run ``loop_depth - 1``
+    additional fused iterations INSIDE the same dispatch (the
+    ``decode_loop_step`` body verbatim). Returns
+    ``(state, emitted [R, W], n_emitted [R], row_logits [R, vocab],
+    loop_block [loop_depth-1, max_seqs])`` with ``W = spec_width + 1``.
 
-    - Decode rows read their input token from ``state.last_tokens[slot]``
-      and their position from ``state.context_lens[slot]`` ON DEVICE, so
-      the host needs no fetch before dispatching the next round. Their
-      padding columns (1..C-1) compute but are causally downstream of
-      nothing — column 0's output is exactly the ``decode_step`` math.
-    - ``arm`` rows (decode rows AND prefill rows whose prompt completes
-      this chunk — the host knows at dispatch) sample their next token
-      from the last-valid-row logits with per-row sampling params and
-      write it into ``last_tokens``; a completing prefill row's sampled
-      token IS its first generated token, greedy-identical to
-      ``commit_first_token`` without the extra micro-dispatch. One rng
-      split per mixed step (same discipline as ``decode_step``): greedy
-      streams are byte-identical to the split path; non-greedy streams
-      are distribution-equal but consume the rng in a different order.
-    - KV lands via the chunk scatter for ALL rows (one full-cache copy
-      per round, already paid by the prefill side); ``last_tokens`` is
-      updated as a DELTA scatter-add so the duplicate-slot padding rows
-      (delta 0) cannot race the real row's write.
+    - Device-read rows (``row_from_device``) take their first token from
+      ``state.last_tokens[slot]`` and start at ``context_lens[slot]`` ON
+      DEVICE; spec rows' drafts ride the packed buffer at offsets 1..Kd.
+    - Spec acceptance is the ``verify_step`` math verbatim: draft i commits
+      iff it equals THIS forward's argmax at its position;
+      ``emitted[r, :n_emitted[r]]`` are the row's tokens (1..Kd+1 for spec
+      rows, 1 for armed plain rows, 0 for mid-prompt prefill rows), and
+      rejected drafts' KV lands beyond the new ``context_lens``.
+    - ``row_logits`` is each row's sampling-position logits (position 0
+      for device rows, the last valid chunk token for prefill rows) — the
+      host-side grammar-pick path, exactly ``decode_step return_logits``.
+    - One rng split for the packed round plus one per tail iteration —
+      the same per-iteration discipline as ``decode_step`` /
+      ``decode_loop_step``; greedy streams are rng-independent.
+    - ``last_tokens`` commits as a DELTA scatter-add so duplicate-slot
+      padding rows (delta 0) cannot race the real row's write; the tail
+      reads the committed tokens, so a loop slot's phase-1 token chains
+      into its fused tail exactly like K single steps.
 
-    Host contract (scheduler ``_use_mixed``): no grammar-constrained,
-    spec-decode, decode-loop, or ring/seq-sharded rows ride a mixed step —
-    those demote the iteration to the split path.
-
-    Numerics contract (tests/test_mixed_step.py, bench --mixed-sweep): the
-    mixed path is the same MATH as the split path, and greedy streams are
-    byte-identical at fp32 (CI-gated). At bf16 the caveat ``verify_step``
-    documents applies here too: a decode row computes at the ragged
-    [rows, chunk] shape instead of [max_seqs, 1], so a last-ulp KV
-    difference can flip a later near-tie argmax — either stream is a valid
-    greedy decode of the same weights.
+    Numerics contract (tests/test_mixed_step.py, bench --ragged-sweep):
+    same MATH as the split path per token; greedy streams byte-identical
+    at fp32 (CI-gated). The documented bf16 near-tie caveat of
+    ``verify_step``/PR 4 applies unchanged: a token computed at the packed
+    shape can differ in the last ulp from the ``[max_seqs, 1]`` shape and
+    flip a later near-tie argmax — either stream is a valid greedy decode.
     """
-    N, C = tokens.shape
-    row_last = state.last_tokens[slots]  # [N]
-    row_start = jnp.where(is_decode, state.context_lens[slots], start_pos)
-    tokens = tokens.at[:, 0].set(jnp.where(is_decode, row_last, tokens[:, 0]))
-    positions = row_start[:, None] + jnp.arange(C)[None, :]  # [N, C]
-    page_rows = state.page_table[slots]  # [N, max_pages]
-
-    attention = _paged_attention_fn(
-        page_rows, row_start, n_valid, page_size, config.n_kv_heads, attn_backend
+    T = tokens.shape[0]
+    R = row_slot.shape[0]
+    B = state.context_lens.shape[0]
+    W = spec_width + 1
+    tok_row = jnp.asarray(tok_row, jnp.int32)
+    tok_valid = tok_row < R
+    safe_row = jnp.minimum(tok_row, R - 1)
+    q_start = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32),
+         jnp.cumsum(row_len, dtype=jnp.int32)[:-1]]
+    )  # [R] exclusive — rows packed in ascending contiguous order
+    tok_off = jnp.arange(T, dtype=jnp.int32) - q_start[safe_row]
+    eff_start = jnp.where(
+        row_from_device, state.context_lens[row_slot], row_start
+    )  # [R]
+    tok_pos = jnp.where(tok_valid, eff_start[safe_row] + tok_off, 0)
+    row_last = state.last_tokens[row_slot]  # [R]
+    tok_in = jnp.where(
+        tok_valid & row_from_device[safe_row] & (tok_off == 0),
+        row_last[safe_row], tokens,
     )
-    # hidden states only, then project each row's last valid position —
-    # same [N, vocab]-not-[N, C, vocab] memory argument as prefill_step
+    page_rows = state.page_table[row_slot]  # [R, max_pages]
+    row_kv_len = jnp.where(row_len > 0, eff_start + row_len, 0)  # [R]
+
+    attention = _ragged_attention_fn(
+        page_rows, tok_row, tok_pos, row_kv_len, tok_valid,
+        page_size, config.n_kv_heads, attn_backend,
+    )
+    # hidden states only, then project only each row's sampling positions —
+    # the [T, vocab] fp32 logits tensor would cost GBs at production shapes
     hidden, (k_pages, v_pages, k_scales, v_scales) = forward(
-        params, tokens, positions,
+        params, tok_in[None], tok_pos[None],
         config=config, attention=attention,
         cache=(state.k_pages, state.v_pages, state.k_scales, state.v_scales),
         return_hidden=True,
     )
-    last_hidden = jnp.take_along_axis(
-        hidden, jnp.maximum(n_valid - 1, 0)[:, None, None], axis=1
-    )[:, 0]  # [N, D]
-    last_logits = lm_head(params, last_hidden, config=config)  # [N, vocab]
+    h = hidden[0]  # [T, D]
+
+    # sampling positions: spec rows need logits at EVERY row position
+    # (ascending, for draft acceptance); every other row only at its last
+    # valid token — all W columns point there, so column 0 is always the
+    # row's sampling position
+    col = jnp.arange(W, dtype=jnp.int32)[None, :]  # [1, W]
+    last_off = jnp.maximum(row_len - 1, 0)[:, None]  # [R, 1]
+    sel_off = jnp.where(
+        (row_n_drafts > 0)[:, None], jnp.minimum(col, last_off), last_off
+    )
+    sel_idx = jnp.clip(q_start[:, None] + sel_off, 0, T - 1)  # [R, W]
+    logits = lm_head(params, h[sel_idx], config=config)  # [R, W, vocab] fp32
+    preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [R, W]
+
+    # spec acceptance — verify_step's math over the packed drafts: draft
+    # column i (1..W-1) is accepted while every earlier draft matched and
+    # it equals the model's prediction for its position
+    cols_d = jnp.arange(1, W, dtype=jnp.int32)[None, :]  # [1, W-1]
+    draft_tok = tok_in[jnp.clip(q_start[:, None] + cols_d, 0, T - 1)]
+    match = (cols_d <= row_n_drafts[:, None]) & (draft_tok == preds[:, :-1])
+    accepted = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(axis=1)  # [R]
 
     rng, sub = jax.random.split(state.rng)
-    next_tokens = sample(last_logits, sub, temperature, top_p, top_k)  # [N]
-    delta = jnp.where(arm, next_tokens - row_last, 0)
+    row_logits = logits[:, 0, :]  # [R, vocab] — each row's sampling position
+    sampled0 = sample(row_logits, sub, temperature, top_p, top_k)  # [R]
+    emitted = jnp.concatenate([sampled0[:, None], preds[:, 1:]], axis=1)
+    n_emitted = jnp.where(
+        row_arm, jnp.where(row_n_drafts > 0, accepted + 1, 1), 0
+    )
+    last_tok = jnp.take_along_axis(emitted, accepted[:, None], axis=1)[:, 0]
 
-    new_state = dataclasses.replace(
+    # context advance: spec rows move by what they EMITTED (rejected
+    # drafts' KV stays beyond the new length); every other row by its
+    # packed length (chunk for prefill, 1 for decode, 0 for padding)
+    advance = jnp.where(row_n_drafts > 0, n_emitted, row_len)
+    delta = jnp.where(row_arm, last_tok - row_last, 0)
+    state = dataclasses.replace(
         state,
         k_pages=k_pages,
         v_pages=v_pages,
         k_scales=k_scales,
         v_scales=v_scales,
-        context_lens=state.context_lens.at[slots].add(n_valid),
-        last_tokens=state.last_tokens.at[slots].add(delta),
+        context_lens=state.context_lens.at[row_slot].add(advance),
+        last_tokens=state.last_tokens.at[row_slot].add(delta),
         rng=rng,
     )
-    return new_state, next_tokens, last_logits
+
+    # fused K-token tail: loop-eligible decode slots free-run loop_depth-1
+    # further iterations in the SAME dispatch — the decode_loop_step body
+    # verbatim (same forward, appends, sampling, EOS mask, rng discipline),
+    # so the tail is byte-identical to a split-path block
+    token_block = jnp.full((max(loop_depth - 1, 0), B), -1, jnp.int32)
+    if loop_depth > 1:
+        live0 = loop_active & (state.last_tokens != eos_id)
+
+        def body(i, carry):
+            state, live, token_block = carry
+            toks = state.last_tokens[:, None]  # [B, 1]
+            positions = state.context_lens[:, None]
+            n_valid = live.astype(jnp.int32)
+
+            attn = _paged_attention_fn(
+                state.page_table, state.context_lens, n_valid,
+                page_size, config.n_kv_heads, attn_backend,
+            )
+            step_logits, (kp, vp, ks, vs) = forward(
+                params, toks, positions,
+                config=config, attention=attn,
+                cache=(state.k_pages, state.v_pages,
+                       state.k_scales, state.v_scales),
+            )
+            step_logits = step_logits[:, 0, :]
+            rng, sub = jax.random.split(state.rng)
+            next_tokens = sample(
+                step_logits, sub, loop_temperature, loop_top_p, loop_top_k
+            )
+            state = dataclasses.replace(
+                state,
+                k_pages=kp, v_pages=vp, k_scales=ks, v_scales=vs,
+                context_lens=state.context_lens + n_valid,
+                last_tokens=jnp.where(live, next_tokens, state.last_tokens),
+                rng=rng,
+            )
+            token_block = token_block.at[i].set(
+                jnp.where(live, next_tokens, -1)
+            )
+            live = live & (next_tokens != eos_id)
+            return state, live, token_block
+
+        state, _, token_block = jax.lax.fori_loop(
+            0, loop_depth - 1, body, (state, live0, token_block)
+        )
+    return state, emitted, n_emitted, row_logits, token_block
 
 
 @partial(
@@ -837,6 +999,10 @@ class InferenceEngine:
         # fused multi-step decode (decode_loop_step): tokens per dispatch;
         # 1 = per-token decode_step only (today's behavior)
         self.decode_loop_depth = max(1, engine_cfg.decode_loop_depth)
+        # serving-variant count of the last warmup() (0 = not warmed yet);
+        # the scheduler emits it as the finchat_warmup_compiled_variants
+        # gauge — the ISSUE 10 warmup-matrix-collapse instrument
+        self.compiled_variants = 0
         self.max_pages_per_seq = min(
             engine_cfg.num_pages - 1,
             -(-engine_cfg.max_seq_len // engine_cfg.page_size),
@@ -1153,6 +1319,7 @@ class InferenceEngine:
         t0 = time.perf_counter()
         cfg = self.engine_cfg
         B = cfg.max_seqs
+        n_variants = 0  # compiled-variant tally → finchat_warmup_compiled_variants
         if prefill_batch_sizes is None:
             # every power of two up to AND INCLUDING the scheduler's largest
             # round padding (round_up_pow2 — the shared policy; for a
@@ -1170,25 +1337,36 @@ class InferenceEngine:
                 config=self.config, page_size=self.page_size,
                 attn_backend=self.attn_backend,
             )
+            n_variants += 1
         if cfg.mixed_step:
-            # the ragged mixed prefill+decode variants the scheduler's
-            # mixed path dispatches — pow-2 ROW buckets (prefill rows +
-            # decode rows occupy distinct slots, so their sum never
-            # exceeds max_seqs) × the CHUNK buckets of mixed_chunk_buckets
-            # (full chunk + the short-tail width); all-padding rows
-            # (n_valid = 0, nothing armed) keep it state-neutral
-            for mc in self.mixed_chunk_buckets():
-                for n in prefill_batch_sizes:
-                    zeros = jnp.zeros((n,), jnp.int32)
-                    flags = jnp.zeros((n,), bool)
-                    self.state, _, _ = mixed_step(
-                        self.params, self.state, jnp.zeros((n, mc), jnp.int32),
-                        zeros, zeros, zeros, flags, flags,
-                        jnp.zeros((n,), jnp.float32), jnp.ones((n,), jnp.float32),
-                        jnp.zeros((n,), jnp.int32),
-                        config=self.config, page_size=self.page_size,
-                        attn_backend=self.attn_backend,
-                    )
+            # the packed ragged variants the scheduler's mixed path
+            # dispatches (ragged_mixed_step) — ONE pow-2 packed-token
+            # bucket axis, descriptors fixed at [max_seqs]; all-padding
+            # rows (row_len 0, nothing armed, no loop slots) keep it
+            # state-neutral. Replaces PR 4's row-bucket × chunk-bucket
+            # matrix AND its per-mode demotions — the collapsed warmup
+            # matrix is the point (ISSUE 10; the gauge below records it).
+            R = B
+            rz = jnp.zeros((R,), jnp.int32)
+            rflags = jnp.zeros((R,), bool)
+            bflags = jnp.zeros((B,), bool)
+            bz = jnp.zeros((B,), jnp.float32)
+            bo = jnp.ones((B,), jnp.float32)
+            bk = jnp.zeros((B,), jnp.int32)
+            for t in self.ragged_token_buckets():
+                self.state, _, _, _, _ = ragged_mixed_step(
+                    self.params, self.state,
+                    jnp.zeros((t,), jnp.int32), jnp.full((t,), R, jnp.int32),
+                    rz, rz, rz, rflags, rflags, rz,
+                    jnp.zeros((R,), jnp.float32), jnp.ones((R,), jnp.float32),
+                    jnp.zeros((R,), jnp.int32),
+                    bflags, bz, bo, bk, jnp.int32(-1),
+                    config=self.config, page_size=self.page_size,
+                    attn_backend=self.attn_backend,
+                    spec_width=cfg.spec_tokens,
+                    loop_depth=self.decode_loop_depth,
+                )
+                n_variants += 1
         inactive = jnp.zeros((B,), bool)
         temp = jnp.full((B,), 1.0, jnp.float32)
         top_p = jnp.ones((B,), jnp.float32)
@@ -1199,6 +1377,7 @@ class InferenceEngine:
                 config=self.config, page_size=self.page_size,
                 attn_backend=self.attn_backend, return_logits=return_logits,
             )
+            n_variants += 1
         if self.decode_loop_depth > 1:
             # the fused multi-step block the scheduler's decode_loop mode
             # dispatches — all slots inactive, so writes trash-redirect and
@@ -1211,6 +1390,7 @@ class InferenceEngine:
                 attn_backend=self.attn_backend,
                 loop_depth=self.decode_loop_depth,
             )
+            n_variants += 1
         if cfg.spec_tokens > 0:
             # both verify-step variants (the scheduler's spec decode path)
             zero_drafts = jnp.zeros((B, cfg.spec_tokens), jnp.int32)
@@ -1222,11 +1402,13 @@ class InferenceEngine:
                     config=self.config, page_size=self.page_size,
                     attn_backend=self.attn_backend, return_logits=return_logits,
                 )
+                n_variants += 1
         self.state, _ = commit_first_token(
             self.state, jnp.int32(0),
             jnp.zeros((self.config.vocab_size,), jnp.float32),
             jnp.float32(0.0), jnp.float32(1.0), jnp.int32(0),
         )
+        n_variants += 1
         # ring-prefill length buckets (seq > 1 meshes): every bucket the
         # router can produce, INCLUDING the top one covering max_seq_len
         # (stopping at max_seq_len itself would miss e.g. the 8192 bucket a
@@ -1253,6 +1435,7 @@ class InferenceEngine:
                     config=self.config, page_size=self.page_size,
                     mesh=self.mesh, sp_mode=self.sp_mode,
                 )
+                n_variants += 1
                 if S >= top:
                     break
                 S = self._ring_bucket(S + 1)
@@ -1270,6 +1453,7 @@ class InferenceEngine:
                         mesh=self.mesh, prefix_pages=pb,
                         sp_mode=self.sp_mode,
                     )
+                    n_variants += 1
                     if pb >= top_pb:
                         break
                     pb = min(pb * 2, top_pb)
@@ -1279,9 +1463,14 @@ class InferenceEngine:
             f" (compilation cache: {cfg.compilation_cache_dir})"
             if cfg.compilation_cache_dir else ""
         )
+        # recorded for the warmup-matrix-collapse observability (ISSUE 10):
+        # the scheduler re-emits it as the finchat_warmup_compiled_variants
+        # gauge through its (possibly replica-labeled) metrics view
+        self.compiled_variants = n_variants
         logger.info(
-            "engine warmup: prefill batches %s + decode variants compiled in %.1fs%s",
-            prefill_batch_sizes, elapsed, cache_note,
+            "engine warmup: prefill batches %s + %d serving variants "
+            "compiled in %.1fs%s",
+            prefill_batch_sizes, n_variants, elapsed, cache_note,
         )
         return elapsed
 
@@ -1296,35 +1485,57 @@ class InferenceEngine:
         )
         return (next_tokens, logits) if return_logits else next_tokens
 
-    def mixed_chunk_buckets(self) -> list[int]:
-        """Column-width buckets for the mixed step (ascending). A decode
-        row pays dense compute for every padded column, so a round whose
-        prefill tails are all short must not pad D decode rows to the full
-        ``prefill_chunk`` — at the production chunk (512) with a full slot
-        batch that would be a ~60× FLOPs blowup for a 20-token tail (the
-        prefix/session-cache-assisted common case). Bounded to TWO pow-2
-        buckets — ``prefill_chunk`` and ``prefill_chunk/8`` — so warmup
-        stays at 2×log2(max_seqs) mixed variants, not a full pow-2 grid."""
-        C = self.engine_cfg.prefill_chunk
-        return sorted({max(1, round_up_pow2(-(-C // 8))), C})
+    def ragged_token_buckets(self) -> list[int]:
+        """Packed-token buckets for the ragged mixed step (ascending
+        pow-2). ONE dimension replaces PR 4's row-bucket × chunk-bucket
+        matrix: the dispatch shape varies only in the packed buffer length
+        (descriptors are fixed at ``[max_seqs]``), so the compiled-variant
+        count is log2 in max_seqs × chunk instead of their product — and
+        spec/loop/constrained rows reuse the SAME variants instead of
+        demoting to per-mode dispatch schedules. Floored at 64 tokens:
+        small rounds pad into the smallest warmed bucket (padding rows are
+        fully masked), trading a little dead compute at light load for
+        fewer startup compiles."""
+        cfg = self.engine_cfg
+        top = round_up_pow2(
+            cfg.max_seqs * max(cfg.prefill_chunk, cfg.spec_tokens + 1)
+        )
+        buckets = [min(64, top)]
+        while buckets[-1] < top:
+            buckets.append(buckets[-1] * 2)
+        return buckets
 
-    def mixed(self, tokens, slots, start_pos, n_valid, is_decode, arm,
-              temperature, top_p, top_k):
-        """One unified mixed prefill+decode dispatch (see mixed_step);
-        returns the sampled next-token row vector [N] (device array — the
-        scheduler fetches it once per round). Counted at the dispatch seam
+    def ragged_bucket(self, n_tokens: int) -> int:
+        """Smallest warmed packed-token bucket holding ``n_tokens``."""
+        return next(b for b in self.ragged_token_buckets() if b >= n_tokens)
+
+    def ragged_mixed(self, tokens, tok_row, row_slot, row_start, row_len,  # finchat-lint: hot
+                     row_from_device, row_arm, row_n_drafts,
+                     temperature, top_p, top_k,
+                     loop_active, loop_temperature, loop_top_p, loop_top_k,
+                     eos_id: int):
+        """One packed ragged dispatch (see ragged_mixed_step); returns
+        ``(emitted, n_emitted, row_logits, loop_block)`` device arrays —
+        the scheduler fetches once per round. Counted at the dispatch seam
         like decode()/decode_loop(), so bench.py's dispatches-per-iteration
         figure reads real enqueued device programs."""
         from finchat_tpu.utils.metrics import METRICS
 
         METRICS.inc("finchat_mixed_dispatches_total")
-        self.state, next_tokens, _last_logits = mixed_step(
-            self.params, self.state, tokens, slots, start_pos, n_valid,
-            is_decode, arm, temperature, top_p, top_k,
-            config=self.config, page_size=self.page_size,
-            attn_backend=self.attn_backend,
+        self.state, emitted, n_emitted, row_logits, loop_block = (
+            ragged_mixed_step(
+                self.params, self.state, tokens, tok_row, row_slot,
+                row_start, row_len, row_from_device, row_arm, row_n_drafts,
+                temperature, top_p, top_k,
+                loop_active, loop_temperature, loop_top_p, loop_top_k,
+                jnp.int32(eos_id),
+                config=self.config, page_size=self.page_size,
+                attn_backend=self.attn_backend,
+                spec_width=self.engine_cfg.spec_tokens,
+                loop_depth=self.decode_loop_depth,
+            )
         )
-        return next_tokens
+        return emitted, n_emitted, row_logits, loop_block
 
     def decode_loop(self, active, temperature, top_p, top_k, eos_id: int,
                     depth: int | None = None):
@@ -1354,6 +1565,14 @@ class InferenceEngine:
                     return_logits: bool = False):
         """Speculative verify step (see verify_step). ``drafts`` [B, Kd]
         keys the compiled shape — callers pad to a fixed Kd."""
+        from finchat_tpu.utils.metrics import METRICS
+
+        # counted at the DISPATCH seam like decode()/decode_loop()/mixed:
+        # a verify step is one enqueued device program, and bench.py's
+        # dispatches-per-iteration figures must see the spec plane too
+        # (the split-path baseline of --ragged-sweep under-counted by the
+        # whole verify cadence before this)
+        METRICS.inc("finchat_decode_dispatches_total")
         self.state, emitted, n_emitted, logits = verify_step(
             self.params, self.state, active, drafts, n_drafts,
             temperature, top_p, top_k,

@@ -34,16 +34,19 @@ model replica:
   single-step (mirroring the SPEC_MISS_DEMOTE machinery) and rejoin
   blocks when eligibility returns; slots that finish mid-block free-run
   into the trash page and their tail iterations are counted as waste.
-- Unified mixed prefill+decode step (``engine.mixed_step``, default on):
-  when prefill work and in-flight decodes coexist, the iteration runs ONE
-  ragged ``mixed_step`` dispatch — every prefilling row advances a chunk
-  and every decoding row a token as a length-1 row of the same batch,
-  with on-device sampling for decode rows and completing prefill rows —
-  instead of a serialized prefill round plus a decode step. Spec decode,
-  decode_loop blocks, grammar-constrained picks, and ring/seq-sharded
-  prefill demote the iteration to the split path below, which remains the
+- Unified packed ragged step (``engine.mixed_step``, default on; ISSUE
+  10): when prefill work and in-flight decodes coexist, the iteration
+  runs ONE ``ragged_mixed_step`` dispatch over a PACKED token buffer
+  (ops/ragged_paged_attention.py) — every prefilling row advances a
+  chunk, every decoding row a token, grammar-constrained rows return
+  their logits for the host pick, spec-eligible rows verify a
+  (1+Kd)-token draft block, and loop-eligible rows free-run a fused
+  ``loop_depth-1`` tail, all with on-device sampling — instead of two or
+  more serialized dispatches. Only ring/seq-sharded prefill rows demote
+  the iteration to the split path below, which remains the
   golden-identical fallback (greedy streams are byte-identical either
-  way; tests/test_mixed_step.py pins it).
+  way; tests/test_mixed_step.py pins it); demotions are counted per
+  reason in ``finchat_mixed_demotions_total``.
 - Session KV cache (engine/session_cache.py): sequences submitted with a
   ``conversation_id`` snapshot their KV pages device→host when they retire
   normally (eos/length, before the pages are freed) and the conversation's
@@ -314,15 +317,31 @@ class ContinuousBatchingScheduler:
         # and spec-decode iterations keep their own depth-1 verify cadence
         self.loop_depth = engine.decode_loop_depth
         self.metrics.set_gauge("finchat_decode_loop_depth", self.loop_depth)
-        # unified mixed prefill+decode step (engine.mixed_step config): one
-        # ragged dispatch advances every prefilling row a chunk AND every
-        # decoding row a token whenever both populations exist and nothing
-        # needs its own dispatch schedule — see _use_mixed / _mixed_round
+        # unified packed ragged step (engine.mixed_step config): one
+        # dispatch advances every prefilling row a chunk, every decoding
+        # row a token, spec rows a verify block, and loop-eligible rows a
+        # fused tail whenever both populations exist — see _use_mixed /
+        # _ragged_round (ISSUE 10). Only ring-routed prefill demotes.
         self.mixed_enabled = bool(cfg.mixed_step)
+        # demotion observability (ISSUE 10 satellite): every reason the
+        # old padded mixed step demoted on is pre-seeded at zero, so the
+        # erasure (spec/decode_loop/constrained stuck at 0, only ring — a
+        # collective schedule — still firing) is visible per replica
+        for reason in self.MIXED_DEMOTION_REASONS:
+            self.metrics.inc("finchat_mixed_demotions_total", 0.0,
+                             labels={"reason": reason})
         # whether the CURRENT loop iteration ran (or will run) prefill
         # work — the finchat_inter_token_seconds label distinguishing the
         # admission-stall case from steady decode
         self._iter_ran_prefill = False
+        # dispatch-seam tally attributed to coexist iterations: every
+        # model dispatch this scheduler enqueues bumps _dispatch_tally,
+        # and the span from one coexist iteration's start to the next
+        # accounting point lands in finchat_coexist_dispatches_total — so
+        # dispatches-per-coexist-iteration (the bench --ragged-sweep
+        # headline) is exact, not a racy window over global counters
+        self._dispatch_tally = 0
+        self._coexist_mark: int | None = None
         # shared-prefix KV cache: matched at admission so identical prompt
         # heads (the constant system prompt every conversation shares) are
         # prefilled ONCE per process instead of per request — see
@@ -431,6 +450,14 @@ class ContinuousBatchingScheduler:
         # different event loop"
         self._wakeup = asyncio.Event()
         self._running = True
+        # warmup-matrix observability (ISSUE 10 satellite): re-emit the
+        # engine's compiled-variant tally through this scheduler's metrics
+        # view, so fleet replicas label it per replica like every other
+        # per-engine family (0 until the engine has been warmed)
+        self.metrics.set_gauge(
+            "finchat_warmup_compiled_variants",
+            getattr(self.engine, "compiled_variants", 0),
+        )
         self._task = asyncio.create_task(self._loop())
 
     async def stop(self) -> None:
@@ -1794,6 +1821,7 @@ class ContinuousBatchingScheduler:
                         # chunked path below exists to avoid
                         with Timer(self.metrics, "finchat_prefill_seconds"):
                             ring_logits = eng.prefill_ring(handle.slot, handle.prompt_ids)
+                        self._dispatch_tally += 1
                         handle.prefill_pos = len(handle.prompt_ids)
                         completions.append((handle, ring_logits, handle.epoch))
                         continue
@@ -1808,6 +1836,7 @@ class ContinuousBatchingScheduler:
                         seg_logits = eng.prefill_ring_segment(
                             handle.slot, seg, handle.prefill_pos
                         )
+                    self._dispatch_tally += 1
                     handle.prefill_pos += len(seg)
                     if handle.prefill_pos >= len(handle.prompt_ids):
                         completions.append((handle, seg_logits, handle.epoch))
@@ -1838,6 +1867,7 @@ class ContinuousBatchingScheduler:
                     config=eng.config, page_size=eng.page_size,
                     attn_backend=eng.attn_backend,
                 )
+            self._dispatch_tally += 1
             for i, handle in enumerate(batch):
                 handle.prefill_pos += int(n_valids[i])
                 if handle.prefill_pos >= len(handle.prompt_ids):
@@ -1888,9 +1918,10 @@ class ContinuousBatchingScheduler:
 
     @staticmethod
     def _pack_prefill_rows(rows, N: int, C: int):
-        """Ragged row arrays for a chunked round (shared by _prefill_round
-        and _mixed_round): one chunk per ``(slot, ids, pos)`` row; padding
-        rows carry the first row's slot with ``n_valid 0`` → trash writes."""
+        """Ragged row arrays for a chunked split-path round
+        (_prefill_round; the packed ragged round builds its own buffer):
+        one chunk per ``(slot, ids, pos)`` row; padding rows carry the
+        first row's slot with ``n_valid 0`` → trash writes."""
         tokens = np.zeros((N, C), np.int32)
         slots = np.zeros((N,), np.int32)
         starts = np.zeros((N,), np.int32)
@@ -1935,42 +1966,53 @@ class ContinuousBatchingScheduler:
         for job in list(self._prefix_jobs):
             self._fail_prefix_job(job)
 
+    # every label the demotion counter can emit — pre-seeded to 0 at
+    # construction so the whole family renders even when (by design, the
+    # ISSUE 10 point) spec / decode_loop / constrained never fire again
+    MIXED_DEMOTION_REASONS = ("spec", "decode_loop", "constrained", "ring", "other")
+
     def _use_mixed(self) -> bool:
-        """Can this iteration run ONE ragged mixed_step dispatch instead of
-        a prefill round plus a decode step? Both populations must exist,
-        and nothing may need its own dispatch schedule: decode_loop blocks
-        (loop_depth > 1), an eligible spec-decode verify step,
-        grammar-constrained picks (host-side, per token), and
-        ring/seq-sharded prefill rows all demote the iteration to the
-        split path — which stays golden-identical, exactly like
-        query_points vs query_points_batch on the retrieval plane."""
-        if not self.mixed_enabled or self.loop_depth > 1 or not self.decoding:
-            return False
-        if self.spec_k > 0 and self._spec_cooldown == 0 and self._spec_candidates():
-            return False
-        if any(h.constraint is not None for h in self.decoding.values()):
+        """Can this iteration run ONE packed ragged dispatch instead of a
+        prefill round plus a decode-side dispatch? Both populations must
+        exist. Since the ragged rebuild (ISSUE 10) spec-decode verify
+        blocks, decode_loop fused tails, and grammar-constrained picks all
+        ride the SAME dispatch as rows of the packed buffer — the old
+        demotion list (PR 4) is erased down to ring/seq-sharded prefill
+        rows, whose collective schedule cannot ride a single-chip packed
+        step. Each demoted coexist-iteration is counted per reason in
+        ``finchat_mixed_demotions_total{reason=...}`` (spec/decode_loop/
+        constrained are pre-seeded at zero — the erasure is observable).
+        The split path stays the golden-identical fallback either way."""
+        if not self.mixed_enabled or not self.decoding:
             return False
         rows = [h for h in self.prefilling if not self._parked(h)]
         if not rows and not self._prefix_jobs:
             return False
-        return not any(
-            self._ring_routed(h) or h.constraint is not None for h in rows
-        )
+        if any(self._ring_routed(h) for h in rows):
+            self.metrics.inc("finchat_mixed_demotions_total",
+                             labels={"reason": "ring"})
+            return False
+        return True
 
-    async def _mixed_round(self) -> None:
-        """Advance EVERY prefilling sequence one chunk AND every decoding
-        slot one token in a single ragged mixed_step dispatch (ISSUE 4):
-        decode rows are length-1 rows of the same [rows, chunk] batch, so
-        an iteration with both populations costs ONE model dispatch
-        instead of a prefill round plus a decode step — the admission
-        stall a long prompt used to add to every in-flight stream's
-        inter-token gap shrinks to the fused step's own time. Prefill rows
-        whose prompt completes this chunk sample their first token
-        on-device in the same dispatch (greedy-identical to
-        commit_first_token). _use_mixed() guarantees no constrained, spec,
-        decode-loop, or ring work is present."""
+    async def _ragged_round(self) -> None:  # finchat-lint: hot
+        """Advance EVERY serving population in a single packed ragged
+        dispatch (ISSUE 10; engine.ragged_mixed_step over
+        ops/ragged_paged_attention.py): prefilling sequences a chunk each,
+        plain decode slots a token, grammar-constrained slots a token with
+        their logits row returned for the host pick, spec-eligible slots a
+        (1+Kd)-token verify block, and loop-eligible slots a further fused
+        ``loop_depth - 1``-token tail — one model dispatch, one host
+        fetch. PR 4's padded mixed step demoted the whole iteration to the
+        serialized split path whenever any of those features was live —
+        exactly the mix a loaded engine runs; now only ring/seq-sharded
+        prefill demotes (_use_mixed). Prefill rows whose prompt completes
+        sample their first token on-device in the same dispatch
+        (greedy-identical to commit_first_token)."""
         eng = self.engine
         C = eng.engine_cfg.prefill_chunk
+        B = eng.engine_cfg.max_seqs
+        Kd = self.spec_k
+        spec_on = Kd > 0 and self._spec_cooldown == 0
         batch: list[SequenceHandle] = []
         for handle in list(self.prefilling):
             if self._parked(handle):
@@ -1986,80 +2028,226 @@ class ContinuousBatchingScheduler:
         decode_members = [
             (slot, h, h.epoch) for slot, h in self.decoding.items()
         ]
-        rows = [(h.slot, h.prompt_ids, h.prefill_pos) for h in batch]
-        rows += [(j.slot, j.ids, j.pos) for j in jobs]
-        if not rows or not decode_members:
+        if (not batch and not jobs) or not decode_members:
             return  # a fault above drained one side; split paths resume next tick
         inject("scheduler.decode", replica=self.replica_id)
         # mixed-specific armable site (ISSUE 5 satellite): targets ONLY the
         # unified dispatch, so tests can fail the fused round while the
         # split fallback paths stay healthy
         inject("scheduler.mixed", replica=self.replica_id)
-        from finchat_tpu.engine.engine import round_up_pow2
+        from finchat_tpu.engine.spec import NgramIndex
 
-        # chunk bucket: decode rows pay dense compute for every padded
-        # column, so a round whose prefill tails are all short rides the
-        # small bucket instead of padding D decode rows to prefill_chunk
-        # (engine.mixed_chunk_buckets — warmup covers both widths)
-        need = max(min(len(ids) - pos, C) for _slot, ids, pos in rows)
-        C = next(b for b in eng.mixed_chunk_buckets() if b >= need)
-        N = round_up_pow2(len(rows) + len(decode_members))
-        tokens, slots, starts, n_valids = self._pack_prefill_rows(rows, N, C)
-        is_decode = np.zeros((N,), bool)
-        arm = np.zeros((N,), bool)
-        temp = np.zeros((N,), np.float32)
-        top_p = np.ones((N,), np.float32)
-        top_k = np.zeros((N,), np.int32)
-        completions: list[tuple[int, SequenceHandle, int]] = []
-        for i, h in enumerate(batch):
-            if h.held or h.prefill_pos + int(n_valids[i]) < len(h.prompt_ids):
+        # one row per live slot (prefill handles, prefix jobs, decode
+        # slots all hold distinct engine slots, so rows <= max_seqs); the
+        # descriptor arrays are fixed [max_seqs] — only the packed-token
+        # bucket varies the compiled shape
+        R = B
+        row_slot = np.zeros((R,), np.int32)
+        row_start = np.zeros((R,), np.int32)
+        row_len = np.zeros((R,), np.int32)
+        row_from_device = np.zeros((R,), bool)
+        row_arm = np.zeros((R,), bool)
+        row_n_drafts = np.zeros((R,), np.int32)
+        temp = np.zeros((R,), np.float32)
+        top_p = np.ones((R,), np.float32)
+        top_k = np.zeros((R,), np.int32)
+        loop_active = np.zeros((B,), bool)
+        packed: list[int] = []
+        tok_row: list[int] = []
+
+        completions: list[tuple[int, SequenceHandle, int]] = []  # (row, h, epoch)
+        prefill_rows: list[tuple[int, SequenceHandle]] = []
+        job_rows: list[tuple[int, _PrefixJob]] = []
+        plain_rows: list[tuple[int, int, SequenceHandle, int]] = []
+        spec_rows: list[tuple[int, int, SequenceHandle, int]] = []
+        constrained_decode: list[tuple[int, int, SequenceHandle, int]] = []
+        constrained_rows: list[int] = []  # row indices whose logits the host needs
+        loop_members: list[tuple[int, SequenceHandle, int]] = []
+        spec_consulted = False
+
+        i = 0
+        for h in batch:
+            chunk = h.prompt_ids[h.prefill_pos : h.prefill_pos + C]
+            row_slot[i] = h.slot
+            row_start[i] = h.prefill_pos
+            row_len[i] = len(chunk)
+            packed += chunk
+            tok_row += [i] * len(chunk)
+            if not h.held and h.prefill_pos + len(chunk) >= len(h.prompt_ids):
+                # prompt completes this chunk: arm the row so its first
+                # token samples on-device with the sequence's own params
+                # (constrained completions keep the non-truncating
+                # defaults — the host pick replaces the sample, and a
+                # truncating top_p/top_k would knock the whole packed
+                # batch off the sampler's exact full-vocab fast path)
+                row_arm[i] = True
+                completions.append((i, h, h.epoch))
+                if h.constraint is not None:
+                    constrained_rows.append(i)
+                else:
+                    s = h.sampling
+                    temp[i], top_p[i], top_k[i] = s.temperature, s.top_p, s.top_k
+            prefill_rows.append((i, h))
+            i += 1
+        for job in jobs:
+            chunk = job.ids[job.pos : job.pos + C]
+            row_slot[i] = job.slot
+            row_start[i] = job.pos
+            row_len[i] = len(chunk)
+            packed += chunk
+            tok_row += [i] * len(chunk)
+            job_rows.append((i, job))
+            i += 1
+        for slot, h, epoch in decode_members:
+            row_slot[i] = slot
+            row_from_device[i] = True
+            row_arm[i] = True
+            if h.constraint is not None:
+                # host-side grammar pick from this row's returned logits
+                # (the depth-1 round consumes within the iteration, so the
+                # pick lands before the slot's next dispatch); sampling
+                # params stay the non-truncating defaults
+                row_len[i] = 1
+                packed.append(0)
+                tok_row.append(i)
+                constrained_rows.append(i)
+                constrained_decode.append((i, slot, h, epoch))
+                i += 1
                 continue
-            # the prompt completes this chunk: arm the row so its first
-            # token samples on-device with the sequence's own params
-            arm[i] = True
+            prop: list[int] = []
+            if spec_on and self._spec_eligible(h):
+                spec_consulted = True
+                if h.ngram_index is None:  # one-time build; _deliver
+                    h.ngram_index = NgramIndex(h.history)  # keeps it in sync
+                remaining = h.sampling.max_new_tokens - h.generated
+                prop = h.ngram_index.propose(min(Kd, remaining - 1))
             s = h.sampling
             temp[i], top_p[i], top_k[i] = s.temperature, s.top_p, s.top_k
-            completions.append((i, h, h.epoch))
-        base = len(rows)
-        for d, (slot, _h, _e) in enumerate(decode_members):
-            i = base + d
-            slots[i] = slot
-            n_valids[i] = 1
-            is_decode[i] = arm[i] = True
-            temp[i] = self._temperature[slot]
-            top_p[i] = self._top_p[slot]
-            top_k[i] = self._top_k[slot]
+            if prop:
+                # spec verify row: [device last_token, d1..dKd'] — the
+                # drafts ride the packed buffer; acceptance on device
+                row_len[i] = 1 + len(prop)
+                row_n_drafts[i] = len(prop)
+                packed.append(0)
+                tok_row.append(i)
+                packed += [int(t) for t in prop]
+                tok_row += [i] * len(prop)
+                spec_rows.append((i, slot, h, epoch))
+            else:
+                row_len[i] = 1
+                packed.append(0)
+                tok_row.append(i)
+                plain_rows.append((i, slot, h, epoch))
+                if self.loop_depth > 1 and self._loop_eligible(h, 0):
+                    # fused K-token tail inside the SAME dispatch: the
+                    # row's phase-1 token plus loop_depth-1 tail tokens
+                    # stay within the budget _loop_eligible checks
+                    loop_active[slot] = True
+                    loop_members.append((slot, h, epoch))
+            i += 1
+
+        T = eng.ragged_bucket(len(packed))
+        packed += [0] * (T - len(packed))
+        tok_row += [R] * (T - len(tok_row))
         with Timer(self.metrics, "finchat_mixed_step_seconds"):
-            next_tokens = eng.mixed(
-                jnp.asarray(tokens), jnp.asarray(slots), jnp.asarray(starts),
-                jnp.asarray(n_valids), jnp.asarray(is_decode), jnp.asarray(arm),
+            emitted_dev, n_em_dev, row_logits_dev, block_dev = eng.ragged_mixed(
+                jnp.asarray(np.asarray(packed, np.int32)),
+                jnp.asarray(np.asarray(tok_row, np.int32)),
+                jnp.asarray(row_slot), jnp.asarray(row_start),
+                jnp.asarray(row_len), jnp.asarray(row_from_device),
+                jnp.asarray(row_arm), jnp.asarray(row_n_drafts),
                 jnp.asarray(temp), jnp.asarray(top_p), jnp.asarray(top_k),
+                jnp.asarray(loop_active), jnp.asarray(self._temperature),
+                jnp.asarray(self._top_p), jnp.asarray(self._top_k),
+                self.eos_id,
             )
-        # prefill bookkeeping happens at dispatch: n_valid is host data
-        for i, h in enumerate(batch):
-            h.prefill_pos += int(n_valids[i])
-        for i, job in enumerate(jobs, start=len(batch)):
-            job.pos += int(n_valids[i])
+        self._dispatch_tally += 1
+        # prefill bookkeeping happens at dispatch: row_len is host data
+        for idx, h in prefill_rows:
+            h.prefill_pos += int(row_len[idx])
+        for idx, job in job_rows:
+            job.pos += int(row_len[idx])
             if job.pos >= job.shared_len:
-                self._complete_prefix_job(job, "mixed")
-        # ONE host fetch serves the decode tokens AND the completions'
-        # first tokens (worker thread keeps the event loop live)
-        toks_host = await asyncio.to_thread(lambda: np.asarray(next_tokens))
-        for i, handle, epoch in completions:
+                self._complete_prefix_job(job, "ragged")
+        logits_sel = None
+        if constrained_rows:
+            # only the constrained rows' logits cross to host — a device
+            # slice [n, vocab], exactly the _dispatch_decode discipline
+            logits_sel = row_logits_dev[jnp.asarray(constrained_rows, jnp.int32)]
+        # ONE host fetch serves decode tokens, spec acceptances, first
+        # tokens, the fused tail block, and the constrained rows' logits
+        # (worker thread keeps the event loop live)
+        emitted, n_emitted, block, logits_host = await asyncio.to_thread(
+            lambda: (
+                np.asarray(emitted_dev), np.asarray(n_em_dev),
+                np.asarray(block_dev),
+                np.asarray(logits_sel) if logits_sel is not None else None,
+            )
+        )
+        for idx, handle, epoch in completions:
             if handle.finished or handle.epoch != epoch:
                 continue  # cancelled/preempted while fetching
             handle.span.mark("prefill_done")
             try:
+                if handle.constraint is not None:
+                    token = self._constrained_pick(
+                        handle, logits_host[constrained_rows.index(idx)]
+                    )
+                else:
+                    token = int(emitted[idx, 0])
                 self.prefilling.remove(handle)
                 self.decoding[handle.slot] = handle
-                self._deliver(handle, int(toks_host[i]))
+                self._deliver(handle, int(token))
             except Exception as e:  # per-sequence isolation
                 logger.error("prefill completion error for %s: %s", handle.seq_id, e)
                 self._evict(handle, "error", error=str(e))
-        for d, (slot, handle, epoch) in enumerate(decode_members):
+        for idx, slot, handle, epoch in constrained_decode:
             if handle.finished or handle.slot != slot or handle.epoch != epoch:
                 continue  # evicted/cancelled/preempted since dispatch
-            self._deliver(handle, int(toks_host[base + d]))
+            token = self._constrained_pick(
+                handle, logits_host[constrained_rows.index(idx)]
+            )
+            self._deliver(handle, token)
+        for idx, slot, handle, epoch in plain_rows:
+            if handle.finished or handle.slot != slot or handle.epoch != epoch:
+                continue
+            self._deliver(handle, int(emitted[idx, 0]))
+        accepted_total = 0
+        for idx, slot, handle, epoch in spec_rows:
+            if handle.finished or handle.slot != slot or handle.epoch != epoch:
+                continue
+            n = int(n_emitted[idx])
+            accepted_total += max(0, n - 1)
+            for token in emitted[idx, :n]:
+                self._deliver(handle, int(token))
+                if handle.finished:  # EOS / length inside the prefix
+                    break
+        if accepted_total:
+            self.metrics.inc("finchat_spec_tokens_accepted_total", accepted_total)
+        if spec_consulted:
+            # the all-miss demotion bookkeeping keeps its split-path
+            # cadence: a ragged round where every proposal missed (or
+            # nothing was accepted) advances the streak
+            self._spec_note_step(accepted=accepted_total)
+        # fused tail: drain each loop slot's [loop_depth-1] row — -1 marks
+        # where the device stop mask kicked in after a phase-1/tail EOS
+        wasted = 0
+        K1 = int(block.shape[0])
+        for slot, handle, epoch in loop_members:
+            if handle.finished or handle.slot != slot or handle.epoch != epoch:
+                wasted += K1  # phase-1 EOS/length/cancel: device free-ran
+                continue
+            for j in range(K1):
+                token = int(block[j, slot])
+                if token < 0:  # device stop mask
+                    wasted += K1 - j
+                    break
+                self._deliver(handle, token)
+                if handle.finished:  # EOS (host view) / length / cancel
+                    wasted += K1 - j - 1
+                    break
+        if wasted:
+            self.metrics.inc("finchat_decode_loop_wasted_tail_tokens_total", wasted)
         self.metrics.set_gauge("finchat_batch_occupancy", len(self.decoding))
 
     def _deliver(self, handle: SequenceHandle, token_id: int) -> None:
@@ -2093,24 +2281,37 @@ class ContinuousBatchingScheduler:
         else:
             handle.events.put_nowait({"type": "token", "token_id": token_id})
 
-    def _dispatch_decode(self, exclude: set[int] = frozenset()) -> _InFlightStep:
+    def _dispatch_decode(
+        self, exclude: set[int] = frozenset(),
+        membership: list[tuple[int, SequenceHandle, int]] | None = None,
+    ) -> _InFlightStep:
         """Enqueue one decode step on the device; returns without syncing.
 
         ``exclude`` slots ride the step INACTIVE (KV writes trash-redirected,
         ``context_lens`` frozen, no token delivered) — used for
         grammar-constrained slots whose host-side pick from the previous
         step has not landed yet, so unconstrained streams keep the depth-2
-        pipeline cadence while a tool decision is in flight."""
+        pipeline cadence while a tool decision is in flight.
+
+        ``membership`` pins the step to an EXPLICIT (slot, handle, epoch)
+        snapshot instead of re-reading ``self.decoding`` — the PR 5 epoch
+        discipline applied to dispatch BUILDING: _dispatch_decode_loop
+        passes its demoted subset so both of the iteration's dispatches
+        derive from the same snapshot (see the regression note there)."""
         inject("scheduler.decode", replica=self.replica_id)
         eng = self.engine
         B = eng.engine_cfg.max_seqs
+        if membership is None:
+            membership = [
+                (slot, h, h.epoch) for slot, h in self.decoding.items()
+            ]
         active = np.zeros((B,), bool)
         members = []
-        for slot, handle in self.decoding.items():
+        for slot, handle, epoch in membership:
             if slot in exclude:
                 continue
             active[slot] = True
-            members.append((slot, handle, handle.epoch))
+            members.append((slot, handle, epoch))
         # step logits come back to host only while a grammar-constrained
         # sequence is IN this step (a second compiled decode variant), and
         # only the constrained rows are transferred — a [n, vocab] device
@@ -2126,6 +2327,7 @@ class ContinuousBatchingScheduler:
             jnp.asarray(self._top_k),
             return_logits=need_logits,
         )
+        self._dispatch_tally += 1
         next_tokens, logits = result if need_logits else (result, None)
         if logits is not None:
             logits = logits[jnp.asarray(constrained_slots, jnp.int32)]
@@ -2179,22 +2381,37 @@ class ContinuousBatchingScheduler:
         syncing. The caller guarantees at least one non-excluded
         loop-eligible slot. ``exclude`` slots (constrained picks still in
         flight) ride fully inactive, exactly as in _dispatch_decode;
-        ``ahead`` is _undelivered() for the in-flight dispatch."""
+        ``ahead`` is _undelivered() for the in-flight dispatch.
+
+        ONE membership snapshot drives BOTH dispatches (regression,
+        ISSUE 10 satellite): the demoted-slot step used to be rebuilt
+        from ``self.decoding`` AFTER the block dispatch
+        (``exclude=set(self.decoding) - demoted``), so a slot vacated by
+        a mid-iteration fault handler and re-populated before the second
+        dispatch would be swept into the demoted step under a handle that
+        was never in this iteration's membership — stepped once by the
+        stale exclusion math and again by its own next iteration
+        (double-step). The snapshot pins both dispatches to the same
+        (slot, handle, epoch) view, the PR 5 discipline membership
+        CONSUMPTION already used."""
         inject("scheduler.decode", replica=self.replica_id)
         eng = self.engine
-        B = eng.engine_cfg.max_seqs
         ahead = ahead or {}
+        B = eng.engine_cfg.max_seqs
+        membership = [
+            (slot, h, h.epoch) for slot, h in self.decoding.items()
+        ]
         active = np.zeros((B,), bool)
         block_members = []
-        demoted: set[int] = set()
-        for slot, handle in self.decoding.items():
+        demoted: list[tuple[int, SequenceHandle, int]] = []
+        for slot, handle, epoch in membership:
             if slot in exclude:
                 continue
             if self._loop_eligible(handle, ahead.get(slot, 0)):
                 active[slot] = True
-                block_members.append((slot, handle, handle.epoch))
+                block_members.append((slot, handle, epoch))
             else:
-                demoted.add(slot)
+                demoted.append((slot, handle, epoch))
         token_block = eng.decode_loop(
             jnp.asarray(active),
             jnp.asarray(self._temperature),
@@ -2202,14 +2419,15 @@ class ContinuousBatchingScheduler:
             jnp.asarray(self._top_k),
             eos_id=self.eos_id,
         )
+        self._dispatch_tally += 1
         self.metrics.inc("finchat_decode_loop_blocks_total")
         self.metrics.set_gauge("finchat_decode_loop_demoted_slots", len(demoted))
         step = None
         if demoted:
-            # demoted slots advance one token via the plain step — exclude
-            # everything that rode the block (and the pending constrained
-            # slots, which sit this iteration out entirely)
-            step = self._dispatch_decode(exclude=set(self.decoding) - demoted)
+            # demoted slots advance one token via the plain step, built
+            # from the SAME snapshot as the block (never re-read from
+            # self.decoding — see the docstring's double-step regression)
+            step = self._dispatch_decode(membership=demoted)
         return _InFlightBlock(
             block_tokens=token_block, block_members=block_members, step=step
         )
@@ -2340,6 +2558,7 @@ class ContinuousBatchingScheduler:
             jnp.asarray(self._top_k),
             return_logits=need_logits,
         )
+        self._dispatch_tally += 1
         emitted, n_emitted, logits = result if need_logits else (*result, None)
         if logits is not None:
             logits = logits[jnp.asarray(constrained_slots, jnp.int32)]
@@ -2430,6 +2649,13 @@ class ContinuousBatchingScheduler:
         inflight: _InFlightStep | _InFlightBlock | None = None
         while self._running:
             self._reap_stale_holds()
+            # attribute the previous coexist iteration's dispatches at the
+            # top of EVERY iteration (idle ones included), so the last
+            # coexist iteration before a quiet period is still booked
+            if self._coexist_mark is not None:
+                self.metrics.inc("finchat_coexist_dispatches_total",
+                                 self._dispatch_tally - self._coexist_mark)
+                self._coexist_mark = None
             # parked holds (prefix prefilled, waiting for extend_prompt)
             # are not work: without the _prefill_work() refinement the
             # loop would busy-spin for the whole retrieval latency
@@ -2476,12 +2702,17 @@ class ContinuousBatchingScheduler:
 
             prefill_active = bool(self._prefix_jobs) or self._prefill_work()
             # label for the inter-token histogram, and the denominator for
-            # the dispatches-per-iteration figure bench --mixed-sweep
+            # the dispatches-per-iteration figure bench --ragged-sweep
             # reports: iterations where prefill work and in-flight decodes
-            # coexist are exactly where the mixed step's 2→1 fusion applies
+            # coexist are exactly where the ragged step's >=2→1 fusion
+            # applies. The mark/attribute pair books every dispatch from a
+            # coexist iteration's start to the next accounting point into
+            # finchat_coexist_dispatches_total — an exact numerator for
+            # dispatches-per-coexist-iteration.
             self._iter_ran_prefill = prefill_active
             if prefill_active and self.decoding:
                 self.metrics.inc("finchat_coexist_iterations_total")
+                self._coexist_mark = self._dispatch_tally
 
             if self._spec_cooldown > 0:
                 # demoted after sustained all-miss steps: count pipelined
@@ -2496,7 +2727,7 @@ class ContinuousBatchingScheduler:
                     inflight = await self._drain_inflight(inflight)
                 if self._use_mixed():  # consuming may have evicted slots
                     try:
-                        await self._mixed_round()
+                        await self._ragged_round()
                         self._note_round_ok("decode")
                         self._note_round_ok("prefill")
                     except Exception as e:

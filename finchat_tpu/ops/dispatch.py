@@ -91,6 +91,60 @@ def paged_attention(
     )
 
 
+def ragged_paged_attention(
+    q: Array,  # [T, H, D] — packed ragged token buffer
+    k_pages: Array,  # [L, P, page_size, Hkv*D] — full-depth cache (or int8)
+    v_pages: Array,
+    page_table: Array,  # [R, max_pages] — per-ROW physical page lists
+    tok_row: Array,  # [T] — owning row per packed token (R = padding)
+    tok_pos: Array,  # [T] — absolute position per packed token
+    kv_len: Array,  # [R] — valid KV per row incl. this dispatch's tokens
+    layer: Array,  # [1] int32
+    *,
+    page_size: int,
+    n_kv: int,
+    backend: str | None = None,
+    k_scales: Array | None = None,  # int8 cache: [L, P, SPAD, page_size] fp32
+    v_scales: Array | None = None,
+) -> Array:
+    """Ragged paged-KV attention (ops/ragged_paged_attention.py): prefill
+    chunks, decode tokens, and spec verify blocks as rows of ONE packed
+    buffer. An int8 cache (engine kv_quant) is detected from the page
+    dtype; the scale arrays must then be provided."""
+    backend = backend or attention_backend()
+    quantized = k_pages.dtype == jnp.int8
+    if quantized:
+        assert k_scales is not None and v_scales is not None
+    if backend == "ref":
+        from finchat_tpu.ops.ragged_paged_attention import (
+            ragged_paged_attention_ref,
+        )
+
+        return ragged_paged_attention_ref(
+            q, k_pages, v_pages, page_table, tok_row, tok_pos, kv_len, layer,
+            page_size=page_size, n_kv=n_kv,
+            k_scales=k_scales if quantized else None,
+            v_scales=v_scales if quantized else None,
+        )
+    interpret = backend == "pallas-interpret"
+    if quantized:
+        from finchat_tpu.ops.ragged_paged_attention import (
+            ragged_flash_attention_q8,
+        )
+
+        return ragged_flash_attention_q8(
+            q, k_pages, v_pages, k_scales, v_scales, page_table,
+            tok_row, tok_pos, kv_len, layer,
+            page_size=page_size, n_kv=n_kv, interpret=interpret,
+        )
+    from finchat_tpu.ops.ragged_paged_attention import ragged_flash_attention
+
+    return ragged_flash_attention(
+        q, k_pages, v_pages, page_table, tok_row, tok_pos, kv_len, layer,
+        page_size=page_size, n_kv=n_kv, interpret=interpret,
+    )
+
+
 def causal_attention(q: Array, k: Array, v: Array, *, backend: str | None = None) -> Array:
     """Full contiguous causal attention (training / one-shot prefill)."""
     backend = backend or attention_backend()

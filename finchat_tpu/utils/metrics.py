@@ -23,18 +23,26 @@ resume), ``finchat_session_cache_offloaded_pages_total``,
 the ``finchat_session_offload_seconds`` / ``finchat_session_restore_seconds``
 histograms (D2H snapshot / H2D resume latency).
 
-Mixed-step family (engine mixed_step, scheduler mixed path):
-``finchat_mixed_dispatches_total`` (unified prefill+decode dispatches — one
-per scheduler iteration on the mixed path), ``finchat_mixed_step_seconds``
-(host-side dispatch+fetch time per mixed round),
-``finchat_coexist_iterations_total`` (scheduler iterations where prefill
-work and in-flight decodes coexist — the denominator for the
-dispatches-per-iteration figure bench.py --mixed-sweep reports; the split
-path pays ~2 model dispatches per such iteration, the mixed path 1), and
+Ragged/mixed-step family (engine ragged_mixed_step, scheduler ragged
+path — ISSUE 10): ``finchat_mixed_dispatches_total`` (unified packed
+dispatches — one per scheduler iteration on the ragged path),
+``finchat_mixed_step_seconds`` (host-side dispatch+fetch time per ragged
+round), ``finchat_coexist_iterations_total`` (scheduler iterations where
+prefill work and in-flight decodes coexist) and
+``finchat_coexist_dispatches_total`` (model dispatches BOOKED to those
+iterations by the scheduler's own attribution — together the exact
+dispatches-per-coexist-iteration figure bench.py --ragged-sweep reports;
+the split path pays >= 2 per such iteration, the ragged path 1),
+``finchat_mixed_demotions_total{reason=spec|decode_loop|constrained|ring|
+other}`` (coexist iterations demoted to the split path, per reason —
+spec/decode_loop/constrained are pre-seeded at zero and stay there since
+the ragged rebuild; only ring still fires),
+``finchat_warmup_compiled_variants`` (serving-variant count of the last
+engine warmup — the collapsed row×chunk×mode matrix), and
 ``finchat_inter_token_seconds`` — a histogram of per-sequence inter-token
 gaps LABELED by ``prefill_concurrent`` ("yes" when the emitting iteration
 also ran prefill work, "no" for steady decode), the instrument that makes
-the mixed step's admission-stall win visible in Prometheus.
+the ragged step's admission-stall win visible in Prometheus.
 
 Resilience family (scheduler preemption/breaker/deadline plane, ISSUE 5 —
 ROBUSTNESS.md): ``finchat_preemptions_total`` (recompute preemptions —

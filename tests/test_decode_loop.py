@@ -362,3 +362,56 @@ def test_wasted_tail_metric_counts_free_run(params):
     asyncio.run(run(eos))
     after = METRICS.get("finchat_decode_loop_wasted_tail_tokens_total")
     assert after > before
+
+
+def test_demoted_step_pinned_to_membership_snapshot(params):
+    """Regression (ISSUE 10 satellite): _dispatch_decode_loop derives BOTH
+    of the iteration's dispatches — the fused block AND the demoted-slot
+    step — from ONE membership snapshot. The pre-fix code rebuilt the
+    demoted step's exclusion set from ``self.decoding`` AFTER the block
+    dispatch, so a slot vacated by a mid-iteration fault handler and
+    re-populated before the second dispatch was swept into the demoted
+    step under a handle that was never in this iteration's membership —
+    stepped once there and again by its own next iteration (double-step).
+    """
+    from finchat_tpu.engine.scheduler import SequenceHandle
+
+    _tok, sched = _stack(params, K, eos_id=-1)
+    samp = SamplingParams(temperature=0.0, max_new_tokens=64)
+    hA = SequenceHandle(seq_id="A", prompt_ids=[1, 2, 3], sampling=samp, owner=sched)
+    hB = SequenceHandle(seq_id="B", prompt_ids=[4, 5], sampling=samp, owner=sched)
+    hC = SequenceHandle(seq_id="C", prompt_ids=[6], sampling=samp, owner=sched)
+    hA.slot, hB.slot = 0, 1
+    hB.generated = 62  # 2 tokens of budget left < K → demoted to single-step
+    sched.decoding = {0: hA, 1: hB}
+    sched.free_slots.remove(0)
+    sched.free_slots.remove(1)
+
+    real_loop = sched.engine.decode_loop
+
+    def hijack(*args, **kwargs):
+        blk_tokens = real_loop(*args, **kwargs)
+        # simulate a mid-iteration fault handler between the two
+        # dispatches: B evicted, its freed slot immediately re-populated
+        # by a different handle (the fleet-adoption/readmission shape)
+        sched._evict(hB, "error", error="injected mid-iteration fault")
+        hC.slot = 1
+        sched.free_slots.remove(1)
+        sched.decoding[1] = hC
+        return blk_tokens
+
+    sched.engine.decode_loop = hijack
+    blk = sched._dispatch_decode_loop()
+
+    assert [h.seq_id for _s, h, _e in blk.block_members] == ["A"]
+    assert blk.step is not None
+    step_ids = [h.seq_id for _s, h, _e in blk.step.members]
+    # the demoted step carries the SNAPSHOT member (B — whose eviction the
+    # consume-side finished/epoch guard discards), never the slot's new
+    # occupant: pre-fix, exclude=set(self.decoding)-demoted put C here
+    assert step_ids == ["B"], step_ids
+    # consuming delivers nothing to the never-dispatched C and nothing to
+    # the evicted B beyond its error event
+    asyncio.run(sched._consume_block(blk))
+    assert hC.generated == 0 and hC.events.empty()
+    assert hA.generated == K

@@ -1,0 +1,217 @@
+"""Ragged paged attention kernel vs its jax.lax reference oracle (ISSUE 10).
+
+Runs the Pallas kernel in interpret mode on the CPU test mesh (the same
+matrix runs on-chip under FINCHAT_TESTS_TPU=1 — the kernel joins the
+PARITY.md on-chip matrix at both cache dtypes). The reference itself is
+pinned against per-row ``mha_reference`` over dense gathered KV, which is
+what makes it the fp32 byte-identity anchor for the ragged mixed step."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from finchat_tpu.engine.kv_cache import (
+    gather_kv,
+    scatter_kv_chunk,
+    scatter_kv_chunk_q8,
+)
+from finchat_tpu.ops.ragged_paged_attention import (
+    ragged_flash_attention,
+    ragged_flash_attention_q8,
+    ragged_paged_attention_ref,
+)
+from finchat_tpu.ops.refs import mha_reference
+
+INTERPRET = jax.default_backend() != "tpu"
+ATOL = RTOL = 2e-5 if INTERPRET else 2e-2
+
+L, PS, Hkv, H, D = 2, 8, 2, 4, 16
+LAYER = 1
+
+
+def _build(rows, *, max_pages, num_pages=64, seed=0, quant=False):
+    """Build a paged cache + packed descriptors from ``rows`` =
+    [(q_len, pos0, kv_len)] — row r's q tokens sit at absolute positions
+    [pos0, pos0+q_len) and its pages hold KV for positions [0, kv_len).
+    Returns (q [T,H,D], pages..., page_table, tok_row, tok_pos, kv_len)."""
+    rng = np.random.default_rng(seed)
+    R = len(rows)
+    if quant:
+        k_pages = jnp.zeros((L, num_pages, PS, Hkv * D), jnp.int8)
+        v_pages = jnp.zeros((L, num_pages, PS, Hkv * D), jnp.int8)
+        k_scales = jnp.zeros((L, num_pages, 8, PS), jnp.float32)
+        v_scales = jnp.zeros((L, num_pages, 8, PS), jnp.float32)
+    else:
+        k_pages = jnp.zeros((L, num_pages, PS, Hkv * D), jnp.float32)
+        v_pages = jnp.zeros((L, num_pages, PS, Hkv * D), jnp.float32)
+        k_scales = v_scales = None
+    page_table = np.zeros((R, max_pages), np.int32)
+    next_page = 1
+    kv_lens = np.asarray([kv for _q, _p, kv in rows], np.int32)
+    for r, (_q_len, _pos0, kv_len) in enumerate(rows):
+        n_pages = max(1, -(-kv_len // PS))
+        page_table[r, :n_pages] = range(next_page, next_page + n_pages)
+        next_page += n_pages
+        kk = rng.standard_normal((1, max(kv_len, 1), Hkv, D)).astype(np.float32)
+        vv = rng.standard_normal((1, max(kv_len, 1), Hkv, D)).astype(np.float32)
+        for lay in range(L):
+            if quant:
+                k_pages, v_pages, k_scales, v_scales = scatter_kv_chunk_q8(
+                    k_pages, v_pages, k_scales, v_scales,
+                    jnp.asarray(kk), jnp.asarray(vv),
+                    jnp.asarray(page_table[r][None]),
+                    jnp.asarray([0], jnp.int32),
+                    jnp.asarray([kv_len], jnp.int32), PS, jnp.int32(lay), Hkv,
+                )
+            else:
+                k_pages, v_pages = scatter_kv_chunk(
+                    k_pages, v_pages, jnp.asarray(kk), jnp.asarray(vv),
+                    jnp.asarray(page_table[r][None]),
+                    jnp.asarray([0], jnp.int32),
+                    jnp.asarray([kv_len], jnp.int32), PS, jnp.int32(lay),
+                )
+    T = sum(q for q, _p, _k in rows)
+    tok_row, tok_pos = [], []
+    for r, (q_len, pos0, _kv) in enumerate(rows):
+        tok_row += [r] * q_len
+        tok_pos += list(range(pos0, pos0 + q_len))
+    q = rng.standard_normal((T, H, D)).astype(np.float32)
+    return (jnp.asarray(q), k_pages, v_pages, k_scales, v_scales,
+            jnp.asarray(page_table), jnp.asarray(tok_row, jnp.int32),
+            jnp.asarray(tok_pos, jnp.int32), jnp.asarray(kv_lens))
+
+
+def _pad(q, tok_row, tok_pos, n_pad, R):
+    """Append ``n_pad`` buffer-padding tokens (tok_row == R)."""
+    T, _h, _d = q.shape
+    qp = jnp.concatenate([q, jnp.ones((n_pad, H, D), q.dtype)])
+    rp = jnp.concatenate([tok_row, jnp.full((n_pad,), R, jnp.int32)])
+    pp = jnp.concatenate([tok_pos, jnp.zeros((n_pad,), jnp.int32)])
+    return qp, rp, pp
+
+
+CASES = {
+    # prefill chunk + decode row + spec block — the serving mix
+    "mix": [(13, 0, 13), (1, 9, 10), (3, 5, 8)],
+    # all decode rows (q_len 1), distinct contexts
+    "decode": [(1, 0, 1), (1, 7, 8), (1, 15, 16), (1, 16, 17)],
+    # page-boundary edges: kv_len exactly at page multiples, chunk
+    # crossing a page boundary, chunk starting mid-page
+    "boundary": [(8, 0, 8), (16, 8, 24), (5, 6, 11), (1, 23, 24)],
+    # single long row (one-row dispatch)
+    "single": [(29, 3, 32)],
+    # unaligned lengths around the block_q=8 sublane tile
+    "unaligned": [(7, 0, 7), (9, 2, 11), (8, 8, 16), (2, 1, 3)],
+}
+
+
+@pytest.mark.parametrize("case", sorted(CASES))
+def test_ragged_kernel_matches_reference(case):
+    rows = CASES[case]
+    (q, kp, vp, _ks, _vs, pt, tok_row, tok_pos, kv_len) = _build(
+        rows, max_pages=4, seed=hash(case) % 1000)
+    ref = ragged_paged_attention_ref(
+        q, kp, vp, pt, tok_row, tok_pos, kv_len,
+        jnp.asarray([LAYER], jnp.int32), page_size=PS, n_kv=Hkv)
+    out = ragged_flash_attention(
+        q, kp, vp, pt, tok_row, tok_pos, kv_len,
+        jnp.asarray([LAYER], jnp.int32), page_size=PS, n_kv=Hkv,
+        interpret=INTERPRET)
+    np.testing.assert_allclose(out, ref, atol=ATOL, rtol=RTOL)
+
+
+def test_padding_tokens_are_inert():
+    """Buffer padding (tok_row == R) must neither disturb real rows nor
+    produce non-finite output; the reference yields zeros there."""
+    rows = CASES["mix"]
+    (q, kp, vp, _ks, _vs, pt, tok_row, tok_pos, kv_len) = _build(
+        rows, max_pages=4, seed=3)
+    T = q.shape[0]
+    qp, rp, pp = _pad(q, tok_row, tok_pos, 7, len(rows))
+    base = ragged_flash_attention(
+        q, kp, vp, pt, tok_row, tok_pos, kv_len,
+        jnp.asarray([LAYER], jnp.int32), page_size=PS, n_kv=Hkv,
+        interpret=INTERPRET)
+    padded = ragged_flash_attention(
+        qp, kp, vp, pt, rp, pp, kv_len,
+        jnp.asarray([LAYER], jnp.int32), page_size=PS, n_kv=Hkv,
+        interpret=INTERPRET)
+    np.testing.assert_allclose(padded[:T], base, atol=ATOL, rtol=RTOL)
+    assert np.isfinite(np.asarray(padded)).all()
+    ref = ragged_paged_attention_ref(
+        qp, kp, vp, pt, rp, pp, kv_len,
+        jnp.asarray([LAYER], jnp.int32), page_size=PS, n_kv=Hkv)
+    np.testing.assert_allclose(np.asarray(ref)[T:], 0.0, atol=1e-7)
+
+
+def test_reference_is_per_row_mha_reference():
+    """The oracle is pinned to the SPLIT path's math: each packed token
+    equals ``mha_reference`` over its row's densely gathered KV at the
+    token's absolute position — bitwise (same function, same fp32 ops),
+    which is what the scheduler-level byte-identity contract leans on."""
+    rows = CASES["mix"]
+    (q, kp, vp, _ks, _vs, pt, tok_row, tok_pos, kv_len) = _build(
+        rows, max_pages=4, seed=11)
+    ref = np.asarray(ragged_paged_attention_ref(
+        q, kp, vp, pt, tok_row, tok_pos, kv_len,
+        jnp.asarray([LAYER], jnp.int32), page_size=PS, n_kv=Hkv))
+    k_all, v_all = gather_kv(kp, vp, pt, PS, jnp.int32(LAYER), Hkv)
+    t = 0
+    for r, (q_len, pos0, kv) in enumerate(rows):
+        direct = mha_reference(
+            q[t:t + q_len, None],
+            jnp.broadcast_to(k_all[r][None], (q_len,) + k_all[r].shape),
+            jnp.broadcast_to(v_all[r][None], (q_len,) + v_all[r].shape),
+            causal=True,
+            q_offset=jnp.arange(pos0, pos0 + q_len, dtype=jnp.int32),
+            kv_len=jnp.full((q_len,), kv, jnp.int32),
+        )[:, 0]
+        assert (np.asarray(direct) == ref[t:t + q_len]).all(), (
+            f"row {r} diverged from per-row mha_reference")
+        t += q_len
+
+
+def test_int8_kernel_matches_int8_reference():
+    """The q8 kernel and the q8 reference share the dequantization math —
+    near-bitwise agreement (both dequantize int8 * fp32 scale rows), and
+    both sit within quantization error of the fp32 path."""
+    rows = CASES["boundary"]
+    (q, kp8, vp8, ks, vs, pt, tok_row, tok_pos, kv_len) = _build(
+        rows, max_pages=4, seed=5, quant=True)
+    ref8 = ragged_paged_attention_ref(
+        q, kp8, vp8, pt, tok_row, tok_pos, kv_len,
+        jnp.asarray([LAYER], jnp.int32), page_size=PS, n_kv=Hkv,
+        k_scales=ks, v_scales=vs)
+    out8 = ragged_flash_attention_q8(
+        q, kp8, vp8, ks, vs, pt, tok_row, tok_pos, kv_len,
+        jnp.asarray([LAYER], jnp.int32), page_size=PS, n_kv=Hkv,
+        interpret=INTERPRET)
+    np.testing.assert_allclose(out8, ref8, atol=ATOL, rtol=RTOL)
+    # parity with the fp32 path within int8 quantization error
+    (qf, kpf, vpf, _ks, _vs, ptf, trf, tpf, kvf) = _build(
+        rows, max_pages=4, seed=5, quant=False)
+    reff = ragged_paged_attention_ref(
+        qf, kpf, vpf, ptf, trf, tpf, kvf,
+        jnp.asarray([LAYER], jnp.int32), page_size=PS, n_kv=Hkv)
+    np.testing.assert_allclose(out8, reff, atol=0.12, rtol=0.12)
+
+
+def test_row_count_edges():
+    """1-row and many-row dispatches, including rows whose kv_len exceeds
+    their own chunk (history below the chunk) and fresh rows (kv == q)."""
+    for rows in (
+        [(1, 0, 1)],
+        [(4, 4, 8)],
+        [(1, i, i + 1) for i in range(6)],
+    ):
+        (q, kp, vp, _ks, _vs, pt, tok_row, tok_pos, kv_len) = _build(
+            rows, max_pages=4, seed=len(rows))
+        ref = ragged_paged_attention_ref(
+            q, kp, vp, pt, tok_row, tok_pos, kv_len,
+            jnp.asarray([LAYER], jnp.int32), page_size=PS, n_kv=Hkv)
+        out = ragged_flash_attention(
+            q, kp, vp, pt, tok_row, tok_pos, kv_len,
+            jnp.asarray([LAYER], jnp.int32), page_size=PS, n_kv=Hkv,
+            interpret=INTERPRET)
+        np.testing.assert_allclose(out, ref, atol=ATOL, rtol=RTOL)

@@ -15,8 +15,8 @@ from finchat_tpu.engine.engine import (
     commit_first_token,
     decode_loop_step,
     decode_step,
-    mixed_step,
     prefill_step,
+    ragged_mixed_step,
     verify_step,
 )
 from finchat_tpu.engine.kv_cache import PageAllocator, pages_needed
@@ -124,30 +124,65 @@ def test_warmup_covers_decode_loop_variant():
     assert np.asarray(eng2.state.page_table).sum() == 0
 
 
-def test_warmup_covers_mixed_step_variants():
-    """With mixed_step on (the default) every pow-2 row bucket of the
-    scheduler's unified prefill+decode dispatch must be compiled at
-    startup — the first admission-during-decode must not compile."""
-    eng = _tiny_engine()
+def test_warmup_covers_ragged_step_variants():
+    """With mixed_step on (the default) every packed-token bucket of the
+    scheduler's unified ragged dispatch must be compiled at startup — the
+    first admission-during-decode must not compile. One bucket axis
+    replaces PR 4's row-bucket x chunk-bucket matrix, and spec/loop/
+    constrained rows reuse the same variants (ISSUE 10)."""
+    eng = _tiny_engine(spec_tokens=2, decode_loop_depth=3)
     eng.warmup()
-    before = mixed_step._cache_size()
-    assert before > 0, "warmup compiled no mixed variants"
+    before = ragged_mixed_step._cache_size()
+    assert before > 0, "warmup compiled no ragged variants"
+    assert eng.compiled_variants > 0
 
-    for C in eng.mixed_chunk_buckets():  # full chunk + the short-tail width
-        for n in (1, 2):  # every row bucket the 2-slot engine can dispatch
-            zeros = jnp.zeros((n,), jnp.int32)
-            flags = jnp.zeros((n,), bool)
-            eng.mixed(
-                jnp.zeros((n, C), jnp.int32), zeros, zeros, zeros, flags, flags,
-                jnp.zeros((n,), jnp.float32), jnp.ones((n,), jnp.float32),
-                jnp.zeros((n,), jnp.int32),
-            )
-    assert mixed_step._cache_size() == before, "first mixed dispatch recompiled"
-    # state-neutrality with the mixed variants included
+    B = eng.engine_cfg.max_seqs  # == 2: row 0 prefill, row 1 spec decode
+    R = B
+    zB = jnp.zeros((B,), jnp.float32)
+    loop_active = jnp.zeros((B,), bool).at[1].set(True)
+    for t in eng.ragged_token_buckets():
+        # a serving-shaped round: a 3-token prefill row plus a spec verify
+        # row with one draft riding a loop tail slot — every feature mix
+        # reuses the SAME compiled variant as the all-padding warmup shape
+        toks = [5, 6, 7, 0, 9] + [0] * (t - 5)
+        tok_row = [0, 0, 0, 1, 1] + [R] * (t - 5)
+        eng.ragged_mixed(
+            jnp.asarray(toks, jnp.int32), jnp.asarray(tok_row, jnp.int32),
+            jnp.asarray([0, 1], jnp.int32),  # row slots
+            jnp.zeros((R,), jnp.int32),  # row_start
+            jnp.asarray([3, 2], jnp.int32),  # row_len
+            jnp.asarray([False, True]),  # from_device
+            jnp.asarray([False, True]),  # arm
+            jnp.asarray([0, 1], jnp.int32),  # n_drafts
+            jnp.zeros((R,), jnp.float32), jnp.ones((R,), jnp.float32),
+            jnp.zeros((R,), jnp.int32),
+            loop_active, zB, jnp.ones((B,), jnp.float32),
+            jnp.zeros((B,), jnp.int32), -1,
+        )
+    assert ragged_mixed_step._cache_size() == before, (
+        "first ragged dispatch recompiled")
+    # state-neutrality with the ragged variants included
     eng2 = _tiny_engine()
     eng2.warmup()
     assert np.asarray(eng2.state.context_lens).tolist() == [0, 0]
     assert np.asarray(eng2.state.page_table).sum() == 0
+
+
+def test_ragged_bucket_matrix_collapsed():
+    """The compiled-variant accounting the warmup gauge reports: the
+    ragged bucket list is ONE pow-2 axis whose length never exceeds the
+    old row x chunk matrix, and the top bucket covers the worst-case
+    packed round (every slot a full chunk)."""
+    eng = _tiny_engine()
+    buckets = eng.ragged_token_buckets()
+    cfg = eng.engine_cfg
+    assert buckets == sorted(set(buckets))
+    assert buckets[-1] >= cfg.max_seqs * cfg.prefill_chunk
+    # old matrix: pow-2 row buckets (log2(max_seqs)+1) x 2 chunk buckets
+    import math
+
+    old_matrix = (int(math.log2(1 << (cfg.max_seqs - 1).bit_length())) + 1) * 2
+    assert len(buckets) <= max(old_matrix, 1)
 
 
 def test_warmup_covers_non_power_of_two_max_seqs():
