@@ -181,6 +181,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--durability-smoke", action="store_true",
                    help="CI variant of --durability-sweep (same drill, "
                         "smoke-sized)")
+    p.add_argument("--trace-overhead", action="store_true",
+                   help="tracing-plane gate (ISSUE 12): traced vs untraced "
+                        "decode throughput (< 2%% overhead), a schema-valid "
+                        "Perfetto export for one traced request, and an "
+                        "injected breaker trip producing a checksummed "
+                        "flight-recorder dump with the tripped round's "
+                        "dispatch spans")
     p.add_argument("--fleet-replicas", type=int, default=4,
                    help="replica count for --fleet-sweep")
     p.add_argument("--tpu-timeout", type=float, default=180.0,
@@ -234,7 +241,9 @@ def run_worker(args: argparse.Namespace) -> int:
     faulthandler.dump_traceback_later(max(60.0, args.measure_budget - 10.0), exit=True)
 
     work = resolve_workload(args, "tpu" if platform == "tpu" else "cpu")
-    if args.durability_sweep or args.durability_smoke:
+    if args.trace_overhead:
+        result = measure_trace_overhead()
+    elif args.durability_sweep or args.durability_smoke:
         result = measure_durability_sweep(smoke=args.durability_smoke)
     elif args.fleet_sweep or args.fleet_smoke:
         result = measure_fleet_sweep(
@@ -2147,6 +2156,221 @@ def measure_fleet_sweep(smoke: bool = False, replicas: int = 4) -> dict:
     }
 
 
+def measure_trace_overhead() -> dict:
+    """Tracing-plane gate (ISSUE 12), CPU-runnable through the REAL
+    scheduler on the tiny fp32 config.
+
+    Section A — overhead + identity: the same decode-dominated workload
+    (3 greedy streams) runs in alternating traced/untraced reps on ONE
+    warmed scheduler; throughput compares MEDIAN-of-reps walls on each
+    side (the median absorbs one-sided scheduler-jitter outliers — the
+    quantity under test is a deque append per event), gated < 2%, and
+    the token streams must be byte-identical traced vs untraced (tracing
+    must never change output).
+
+    Section B — export: one traced request's ``TRACER.export`` must be a
+    schema-valid Chrome/Perfetto trace containing admitted → dispatch
+    (with the request's own rows) → first_token → done.
+
+    Section C — flight recorder: ``breaker_threshold`` injected decode
+    faults trip the breaker with a flight dir armed; the dump must load
+    with a valid checksum and contain the trip anomaly plus dispatch
+    spans carrying the tripped streams' trace ids.
+    """
+    import asyncio
+    import dataclasses
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from finchat_tpu.engine.engine import InferenceEngine
+    from finchat_tpu.engine.sampler import SamplingParams
+    from finchat_tpu.engine.scheduler import ContinuousBatchingScheduler
+    from finchat_tpu.models.llama import PRESETS, init_params
+    from finchat_tpu.utils import faults
+    from finchat_tpu.utils.config import EngineConfig
+    from finchat_tpu.utils.metrics import METRICS
+    from finchat_tpu.utils.tracing import TRACER, load_flight_dump
+
+    config = dataclasses.replace(PRESETS["tiny"], dtype=jnp.float32)
+    params = init_params(config, jax.random.key(0))
+
+    def make_scheduler():
+        engine = InferenceEngine(config, params, EngineConfig(
+            max_seqs=4, page_size=8, num_pages=128, max_seq_len=256,
+            prefill_chunk=16, session_cache=False,
+        ))
+        return ContinuousBatchingScheduler(engine, eos_id=-1)
+
+    async def drain(handle):
+        tokens = []
+        while True:
+            ev = await handle.events.get()
+            if ev["type"] == "token":
+                tokens.append(ev["token_id"])
+            elif ev["type"] == "done":
+                return tokens, None
+            else:
+                return tokens, ev
+
+    prompts = [list(range(1, 14)), list(range(20, 38)), list(range(50, 61))]
+    # decode-dominated and long enough that per-rep wall is ~0.3 s on the
+    # tiny CPU config — median-of-7 alternating reps puts scheduler jitter
+    # well under the 2% gate (the quantity under test is a deque append)
+    TOKENS_PER_STREAM = 128
+    greedy = SamplingParams(temperature=0.0, max_new_tokens=TOKENS_PER_STREAM)
+    REPS = 7
+
+    # ---- sections A + B: overhead, identity, export ---------------------
+    async def run_reps(sched):
+        async def rep(traced: bool, tag: str):
+            TRACER.configure(enabled=traced)
+            t0 = time.perf_counter()
+            handles = [
+                await sched.submit(
+                    f"{tag}-{i}", p, greedy,
+                    trace_id=f"trace-{tag}-{i}" if traced else None,
+                )
+                for i, p in enumerate(prompts)
+            ]
+            results = await asyncio.gather(*[drain(h) for h in handles])
+            wall = time.perf_counter() - t0
+            assert all(err is None for _t, err in results), results
+            return wall, [t for t, _e in results]
+
+        await rep(True, "warm")  # compiles + first-touch, discarded
+        walls_off, walls_on = [], []
+        tokens_off = tokens_on = None
+        for r in range(REPS):
+            w, tokens_off = await rep(False, f"off{r}")
+            walls_off.append(w)
+            w, tokens_on = await rep(True, f"on{r}")
+            walls_on.append(w)
+        return walls_off, walls_on, tokens_off, tokens_on
+
+    async def section_ab():
+        sched = make_scheduler()
+        await sched.start()
+        try:
+            return await run_reps(sched)
+        finally:
+            await sched.stop()
+
+    TRACER.clear()
+    walls_off, walls_on, tokens_off, tokens_on = asyncio.run(section_ab())
+    total_tokens = 3 * TOKENS_PER_STREAM
+
+    def mid(walls):  # median absorbs one-sided scheduler-jitter outliers
+        s = sorted(walls)
+        return s[len(s) // 2]
+
+    tput_off = total_tokens / mid(walls_off)
+    tput_on = total_tokens / mid(walls_on)
+    overhead_pct = (mid(walls_on) - mid(walls_off)) / mid(walls_off) * 100.0
+    outputs_identical = tokens_off == tokens_on
+
+    export = TRACER.export(f"trace-on{REPS - 1}-0")
+    names = [e["name"] for e in export["traceEvents"]]
+    own_dispatches = [
+        e for e in export["traceEvents"]
+        if e["name"] == "dispatch"
+        and any(r[1] == f"trace-on{REPS - 1}-0" for r in e["args"]["rows"])
+    ]
+    export_valid = (
+        all(n in names for n in ("admitted", "prefill_done", "first_token",
+                                 "done", "request", "dispatch"))
+        and len(own_dispatches) >= 2  # its prefill + decode rounds
+        and all(e.get("ph") in ("X", "i") and "ts" in e and "tid" in e
+                for e in export["traceEvents"])
+        and bool(json.dumps(export))
+    )
+    print(f"[bench] trace overhead: off={mid(walls_off):.3f}s "
+          f"on={mid(walls_on):.3f}s overhead={overhead_pct:+.2f}% "
+          f"identical={outputs_identical} export_events={len(names)}",
+          file=sys.stderr, flush=True)
+
+    # ---- section C: breaker-trip flight dump ----------------------------
+    flight_dir = tempfile.mkdtemp(prefix="finchat-flight-")
+    rebuilds0 = METRICS.get("finchat_engine_rebuilds_total")
+
+    async def section_c():
+        TRACER.configure(enabled=True, flight_dir=flight_dir)
+        TRACER.clear()
+        sched = make_scheduler()
+        await sched.start()
+        try:
+            handles = [
+                await sched.submit(f"trip-{i}", p, greedy,
+                                   trace_id=f"trace-trip-{i}")
+                for i, p in enumerate(prompts)
+            ]
+            tasks = [asyncio.create_task(drain(h)) for h in handles]
+            while any(h.generated < 2 for h in handles):
+                await asyncio.sleep(0.002)
+            faults.arm("scheduler.decode",
+                       faults.n_shot(sched.breaker_threshold,
+                                     RuntimeError("trace drill: wedged dispatch")))
+            results = [await asyncio.wait_for(t, timeout=300) for t in tasks]
+            return all(err is None for _t, err in results)
+        finally:
+            await sched.stop()
+            faults.disarm_all()
+            TRACER.configure(flight_dir="")
+
+    streams_survived = asyncio.run(section_c())
+    TRACER.flush_dumps()
+    TRACER.configure(enabled=True)
+    import glob as _glob
+
+    dump_paths = sorted(_glob.glob(os.path.join(flight_dir, "flight-*.json")))
+    flight_ok = flight_has_trip = flight_has_dispatch_rows = False
+    if dump_paths:
+        try:
+            rec = load_flight_dump(dump_paths[0])
+            flight_ok = True
+            events = rec["trace"]["traceEvents"]
+            flight_has_trip = (rec["reason"] == "breaker_trip"
+                               and any(e["name"] == "breaker_trip" for e in events))
+            flight_has_dispatch_rows = any(
+                e["name"] == "dispatch"
+                and any(str(r[1]).startswith("trace-trip-")
+                        for r in e["args"]["rows"])
+                for e in events
+            )
+        except ValueError as e:
+            print(f"[bench] flight dump failed validation: {e}",
+                  file=sys.stderr, flush=True)
+    rebuilds = int(METRICS.get("finchat_engine_rebuilds_total") - rebuilds0)
+    print(f"[bench] trace flight drill: dumps={len(dump_paths)} "
+          f"checksum_ok={flight_ok} trip={flight_has_trip} "
+          f"dispatch_rows={flight_has_dispatch_rows} rebuilds={rebuilds} "
+          f"survived={streams_survived}", file=sys.stderr, flush=True)
+
+    return {
+        "metric": "trace_overhead",
+        "model": "tiny-fp32",
+        "tokens_per_rep": total_tokens,
+        "reps": REPS,
+        "walls_untraced_s": [round(w, 4) for w in walls_off],
+        "walls_traced_s": [round(w, 4) for w in walls_on],
+        "tput_untraced_tok_s": round(tput_off, 1),
+        "tput_traced_tok_s": round(tput_on, 1),
+        "overhead_pct": round(overhead_pct, 2),
+        "overhead_under_2pct": overhead_pct < 2.0,
+        "outputs_identical": outputs_identical,
+        "export_valid": export_valid,
+        "export_dispatches": len(own_dispatches),
+        "flight_dumps": len(dump_paths),
+        "flight_checksum_ok": flight_ok,
+        "flight_has_trip": flight_has_trip,
+        "flight_has_dispatch_rows": flight_has_dispatch_rows,
+        "streams_survive_trip": streams_survived,
+        "engine_rebuilds": rebuilds,
+        "double_finish_total": int(METRICS.get("finchat_span_double_finish_total")),
+    }
+
+
 def measure_durability_sweep(smoke: bool = False) -> dict:
     """Crash-restart + graceful-drain drill (ISSUE 7), CPU-runnable through
     a REAL App over the memory Kafka broker on the tiny fp32 config (fp32
@@ -2513,6 +2737,8 @@ def spawn_worker(args: argparse.Namespace, platform: str, timeout: float) -> dic
     if args.fleet_sweep or args.fleet_smoke:
         cmd += ["--fleet-replicas", str(args.fleet_replicas)]
         cmd += ["--fleet-smoke"] if args.fleet_smoke else ["--fleet-sweep"]
+    if args.trace_overhead:
+        cmd += ["--trace-overhead"]
     print(f"[bench] spawning {platform} worker (timeout {timeout:.0f}s)",
           file=sys.stderr, flush=True)
     try:

@@ -67,6 +67,15 @@ def main() -> None:
                         "through to checksummed record files so a restarted "
                         "process resumes conversations warm — also "
                         "FINCHAT_SESSION_CACHE_DISK")
+    p.add_argument("--flight-dir", default=None,
+                   help="anomaly flight-recorder directory (utils/"
+                        "tracing.py — OBSERVABILITY.md): on breaker trip/"
+                        "watchdog fire/shed/give-up/quarantine/SIGTERM the "
+                        "trace ring dumps to a checksummed file here — "
+                        "also FINCHAT_TRACING_FLIGHT_DIR")
+    p.add_argument("--no-tracing", action="store_true",
+                   help="disable request tracing + the flight recorder "
+                        "(tracing.enabled; also FINCHAT_TRACING=0)")
     p.add_argument("--shutdown-deadline-seconds", type=float, default=None,
                    help="graceful SIGTERM drain window: in-flight streams "
                         "may finish for this long before stragglers are "
@@ -93,6 +102,10 @@ def main() -> None:
         overrides["engine.session_cache_disk_path"] = args.session_disk
     if args.shutdown_deadline_seconds is not None:
         overrides["shutdown.deadline_seconds"] = args.shutdown_deadline_seconds
+    if args.flight_dir is not None:
+        overrides["tracing.flight_dir"] = args.flight_dir
+    if args.no_tracing:
+        overrides["tracing.enabled"] = False
     cfg = load_config(args.config, overrides)
 
     from finchat_tpu.serve.app import build_app

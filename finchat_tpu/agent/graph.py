@@ -48,6 +48,7 @@ from finchat_tpu.models.tokenizer import render_chat
 from finchat_tpu.utils.faults import inject
 from finchat_tpu.utils.logging import get_logger
 from finchat_tpu.utils.metrics import METRICS
+from finchat_tpu.utils.tracing import TRACER
 
 logger = get_logger(__name__)
 
@@ -293,6 +294,30 @@ class LLMAgent:
             return None
         return session_key(state.conversation_id, role)
 
+    @staticmethod
+    def _trace(state: AgentState, name: str, **args) -> None:
+        """Agent-plane trace event (ISSUE 12): the PR 9 overlap win made
+        visible per request — decide_start, name_commit, tool_launch,
+        tool_adopted, response_prefill_hold all land on the request's
+        timeline. No-op for untraced requests, so tracing can never
+        change the streamed output (the on/off byte-identity test pins
+        it)."""
+        if state.trace_id is not None and TRACER.enabled:
+            TRACER.event(name, state.trace_id, track="agent",
+                         args=args or None)
+
+    def _gen_kwargs(self, state: AgentState, role: str) -> dict[str, Any]:
+        """Per-role generator kwargs: session key, deadline, and — only
+        when the request is traced — the trace id, so generator doubles
+        in tests that predate the kwarg keep working untraced."""
+        kwargs: dict[str, Any] = {
+            "conversation_id": self._session_key(state, role),
+            "deadline": state.deadline,
+        }
+        if state.trace_id is not None:
+            kwargs["trace_id"] = state.trace_id
+        return kwargs
+
     # --- nodes -----------------------------------------------------------
     async def _decide_retrieval_node(self, state: AgentState) -> AgentState:
         """Node 1: decide whether transaction retrieval is needed.
@@ -310,11 +335,11 @@ class LLMAgent:
         moves WHEN the tool and the prefix prefill start.
         """
         logger.info("Deciding if transaction retrieval is needed")
+        self._trace(state, "decide_start")
         if not self.tool_streaming:
             decision_text = await self.tool_generator.generate(
                 self._tool_prompt_text(state), self.tool_sampling,
-                conversation_id=self._session_key(state, "tool"),
-                deadline=state.deadline,
+                **self._gen_kwargs(state, "tool"),
             )
             tool_call = parse_tool_decision(decision_text)
             if tool_call is not None:
@@ -328,13 +353,13 @@ class LLMAgent:
         launcher = ToolLauncher(
             lambda call: self._execute_streamed(state, call),
             refine=self._refine_tool_result, metrics=self.metrics,
+            trace_id=state.trace_id,
         )
         prefix_task: Any = None
         try:
             async for chunk in self.tool_generator.stream(
                 self._tool_prompt_text(state), self.tool_sampling,
-                conversation_id=self._session_key(state, "tool"),
-                deadline=state.deadline,
+                **self._gen_kwargs(state, "tool"),
             ):
                 for event in parser.feed(chunk):
                     if isinstance(event, ParseAnomaly):
@@ -344,6 +369,7 @@ class LLMAgent:
                         # an incremental/serial mismatch)
                         launcher.abandon()
                     elif isinstance(event, ToolNameComplete):
+                        self._trace(state, "name_commit", tool=event.name)
                         if prefix_task is None and self._overlap_ready(state):
                             prefix_task = asyncio.create_task(self._begin_prefix(state))
                     elif isinstance(event, CallComplete):
@@ -386,14 +412,16 @@ class LLMAgent:
 
     async def _begin_prefix(self, state: AgentState):
         try:
-            return await self.response_generator.begin_partial(
+            handle = await self.response_generator.begin_partial(
                 self._response_prefix_text(state), self.response_sampling,
-                conversation_id=self._session_key(state, "resp"),
-                deadline=state.deadline,
+                **self._gen_kwargs(state, "resp"),
             )
         except Exception as e:  # overlap is an optimization, never fatal
             logger.warning("partial prefill unavailable, serial path: %s", e)
             return None
+        if handle is not None:
+            self._trace(state, "response_prefill_hold")
+        return handle
 
     async def _settle_prefix(self, state: AgentState, prefix_task, *, keep: bool) -> None:
         """Resolve an early static-prefix prefill task into
@@ -563,6 +591,8 @@ class LLMAgent:
             kwargs["deadline"] = state.deadline
         if state.partial_prefill is not None:
             kwargs["partial"] = state.partial_prefill
+        if state.trace_id is not None:
+            kwargs["trace_id"] = state.trace_id
         return kwargs
 
     def _release_partial(self, state: AgentState) -> None:
@@ -608,6 +638,7 @@ class LLMAgent:
         chat_history: list[ChatMessage] | None = None,
         conversation_id: str | None = None,
         deadline: float | None = None,
+        trace_id: str | None = None,
     ) -> dict[str, Any]:
         """Batch path through the compiled graph (reference llm_agent.py:175)."""
         logger.info("Processing query for user %s: %s", user_id, user_query)
@@ -619,6 +650,7 @@ class LLMAgent:
             chat_history=list(chat_history or []),
             tool_calls=deque(),
             deadline=deadline,
+            trace_id=trace_id,
         )
         try:
             final_state = await self.graph.ainvoke(state)
@@ -640,6 +672,7 @@ class LLMAgent:
         chat_history: list[ChatMessage] | None = None,
         conversation_id: str | None = None,
         deadline: float | None = None,
+        trace_id: str | None = None,
     ) -> AsyncGenerator[dict[str, Any], None]:
         """Streaming path with status events (reference llm_agent.py:202-252);
         event shapes/messages kept verbatim."""
@@ -654,6 +687,7 @@ class LLMAgent:
             chat_history=list(chat_history or []),
             tool_calls=deque(),
             deadline=deadline,
+            trace_id=trace_id,
         )
 
         try:

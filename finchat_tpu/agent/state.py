@@ -45,3 +45,9 @@ class AgentState:
     # none), threaded serve/app → agent → generator → scheduler for the
     # shed/EDF admission plane (ROBUSTNESS.md)
     deadline: float | None = None
+    # end-to-end trace id (utils/tracing.py — ISSUE 12): minted at ingress
+    # (Kafka message_id / HTTP x-trace-id), threaded through every
+    # generator call and tool launch so the request's agent decide, tool
+    # overlap, prefill, and dispatch events correlate on one timeline;
+    # None = untraced
+    trace_id: str | None = None

@@ -66,6 +66,7 @@ from finchat_tpu.agent.toolcall import (
 )
 from finchat_tpu.utils.logging import get_logger
 from finchat_tpu.utils.metrics import METRICS
+from finchat_tpu.utils.tracing import TRACER
 
 logger = get_logger(__name__)
 
@@ -402,12 +403,17 @@ class ToolLauncher:
         *,
         refine: Callable[[ToolResult, ToolCall], ToolResult] | None = None,
         metrics=None,
+        trace_id: str | None = None,
     ):
         self._execute = execute
         # host-side refinement for late-committed REFINE_KEYS (e.g. the
         # top-k slice); None = exact-match adoption only
         self._refine = refine
         self.metrics = metrics if metrics is not None else METRICS
+        # end-to-end trace id (utils/tracing.py — ISSUE 12): launches and
+        # adoptions land on the request's timeline, so the Conveyor-style
+        # overlap is visible per request, not just as a histogram
+        self.trace_id = trace_id
         self._task: asyncio.Task | None = None
         self._task_call: ToolCall | None = None
         self._task_started = 0.0
@@ -483,7 +489,14 @@ class ToolLauncher:
             # the slice of the adopted run that hid under decode — the
             # latency a serial decide→execute turn would have paid on top
             saved = max(0.0, min(ended, self._decode_done_at) - started)
-            self.metrics.observe("finchat_tool_overlap_saved_seconds", saved)
+            self.metrics.observe("finchat_tool_overlap_saved_seconds", saved,
+                                 trace_id=self.trace_id)
+        if self.trace_id is not None and TRACER.enabled:
+            # the adopted execution as a complete span (started→ended) —
+            # in Perfetto it visibly overlaps the decision decode
+            TRACER.event("tool_adopted", self.trace_id, ts=started,
+                         dur=max(0.0, ended - started), track="agent",
+                         args={"tool": call.name})
         if task_call != call:
             assert self._refine is not None  # adoptable implies it
             result = self._refine(result, call)
@@ -497,6 +510,9 @@ class ToolLauncher:
         self._task = asyncio.ensure_future(self._timed(call))
         self._task.add_done_callback(_swallow)
         self.metrics.inc("finchat_tool_launches_total")
+        if self.trace_id is not None and TRACER.enabled:
+            TRACER.event("tool_launch", self.trace_id, track="agent",
+                         args={"tool": call.name})
 
     async def _timed(self, call: ToolCall) -> tuple[ToolResult, float]:
         # completion is stamped INSIDE the task: adoption may happen long

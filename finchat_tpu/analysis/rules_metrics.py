@@ -1,5 +1,5 @@
-"""R5 ``metrics-discipline``: metric naming and the labeled-vs-unlabeled
-family convention.
+"""R5 ``metrics-discipline``: metric naming, the labeled-vs-unlabeled
+family convention, and span/trace-event name discipline.
 
 The Prometheus surface is the product's north star (utils/metrics.py);
 PR 6 established the convention this rule enforces mechanically:
@@ -17,6 +17,19 @@ PR 6 established the convention this rule enforces mechanically:
 - one series name must not mix explicit-``labels`` and label-free call
   sites (the render groups by base name; a mixed family splits).
 
+Span discipline (ISSUE 12): every ``span.mark("...")`` literal must come
+from ``utils/tracing.py``'s ``SPAN_MARKS``, every ``TRACER.event("...")``
+literal from the full ``TRACE_EVENT_NAMES`` registry, and every
+``TRACER.anomaly("...")`` literal from ``ANOMALY_KINDS`` — a typo'd name
+otherwise just silently vanishes from every timeline and flight dump.
+Literal names are checked wherever they appear, INCLUDING through the
+repo's forwarding helpers (a call to a ``_trace``-named helper whose
+literal string argument carries the event name); a forwarding helper's
+own non-literal pass-through is exempt by construction, because its call
+sites carry the literals. The registries are read from the analyzed
+set's ``utils/tracing.py`` (fixtures supply a miniature one); with no
+tracing module in scope, the span checks are skipped.
+
 Emission sites are found by shape, not receiver type: a call to
 ``inc`` / ``set_gauge`` / ``observe`` whose first argument is a string
 literal (or a conditional between string literals), or a ``Timer(...,
@@ -31,6 +44,8 @@ import ast
 from finchat_tpu.analysis.core import Finding, ProjectIndex, Rule, dotted_name
 
 _EMITTERS = {"inc", "set_gauge", "observe"}
+# the tracing-registry names read out of utils/tracing.py
+_REGISTRY_VARS = ("SPAN_MARKS", "TRACE_EVENTS", "ANOMALY_KINDS")
 
 
 class MetricsDisciplineRule(Rule):
@@ -71,6 +86,8 @@ class MetricsDisciplineRule(Rule):
                                 mod.relpath, node.lineno, fn.qualname,
                             )
                         )
+
+        findings.extend(self._span_discipline(project))
 
         # mixed labeled/unlabeled families
         for name, occurrences in sorted(sites.items()):
@@ -135,6 +152,112 @@ class MetricsDisciplineRule(Rule):
                     "dashboard sums — the PR 6 convention)"
                 )
         return out
+
+
+    # --- span/trace-event name discipline (ISSUE 12) --------------------
+    def _span_discipline(self, project: ProjectIndex) -> list[Finding]:
+        registries = _tracing_registries(project)
+        if registries is None:
+            return []  # no tracing module in the analyzed set
+        span_marks, trace_events, anomaly_kinds = registries
+        all_names = span_marks | trace_events | anomaly_kinds
+        findings: list[Finding] = []
+
+        def bad(mod, node, fn, msg: str) -> None:
+            findings.append(Finding(self.name, mod.relpath, node.lineno,
+                                    fn.qualname, msg))
+
+        for mod in project.modules.values():
+            if not mod.modname.startswith("finchat_tpu."):
+                continue
+            if mod.relpath.endswith("utils/tracing.py"):
+                continue  # the registry's own internals
+            for fn in mod.functions.values():
+                for site in fn.calls:
+                    node = site.node
+                    func = node.func
+                    if not isinstance(func, ast.Attribute):
+                        continue
+                    receiver = (dotted_name(func.value) or "")
+                    head = receiver.split(".")[-1]
+                    if func.attr == "mark" and head == "span":
+                        for name in _name_literals(node):
+                            if name not in span_marks:
+                                bad(mod, node, fn,
+                                    f"span mark `{name}` is not declared in "
+                                    "SPAN_MARKS (utils/tracing.py) — a typo'd "
+                                    "mark silently vanishes from every timeline")
+                    elif func.attr == "event" and head.lower().endswith("tracer"):
+                        for name in _name_literals(node):
+                            if name not in all_names:
+                                bad(mod, node, fn,
+                                    f"trace event `{name}` is not declared in "
+                                    "the tracing registries (utils/tracing.py)")
+                    elif func.attr == "anomaly" and head.lower().endswith("tracer"):
+                        for name in _name_literals(node):
+                            if name not in anomaly_kinds:
+                                bad(mod, node, fn,
+                                    f"anomaly kind `{name}` is not declared in "
+                                    "ANOMALY_KINDS (utils/tracing.py)")
+                    elif func.attr == "_trace":
+                        # forwarding-helper convention: the literal event
+                        # name rides the helper call (the helper's own
+                        # pass-through to TRACER.event is non-literal and
+                        # exempt — the literals are checked HERE)
+                        for name in _name_literals(node, anywhere=True):
+                            if name in all_names:
+                                break
+                            bad(mod, node, fn,
+                                f"trace name `{name}` forwarded through a "
+                                "_trace helper is not declared in the tracing "
+                                "registries (utils/tracing.py)")
+                            break
+        return findings
+
+
+def _name_literals(node: ast.Call, anywhere: bool = False) -> list[str]:
+    """The event-name string literal(s) of a tracing call: the first
+    positional arg (or ``name=`` keyword); with ``anywhere``, the first
+    string-literal positional at any position (forwarding helpers take
+    ``(state, "name")``-style signatures)."""
+    exprs: list[ast.AST] = []
+    if anywhere:
+        for arg in node.args:
+            if _const_strings(arg):
+                exprs.append(arg)
+                break
+    else:
+        if node.args:
+            exprs.append(node.args[0])
+        for kw in node.keywords:
+            if kw.arg == "name":
+                exprs.append(kw.value)
+    out: list[str] = []
+    for e in exprs:
+        out.extend(_const_strings(e))
+    return out
+
+
+def _tracing_registries(project: ProjectIndex):
+    """(SPAN_MARKS, TRACE_EVENTS, ANOMALY_KINDS) string sets from the
+    analyzed set's ``utils/tracing.py``, or None when absent."""
+    mod = next(
+        (m for m in project.modules.values()
+         if m.relpath.endswith("utils/tracing.py")),
+        None,
+    )
+    if mod is None:
+        return None
+    sets: dict[str, set[str]] = {name: set() for name in _REGISTRY_VARS}
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name) and tgt.id in sets:
+                for inner in ast.walk(node.value):
+                    if isinstance(inner, ast.Constant) and isinstance(inner.value, str):
+                        sets[tgt.id].add(inner.value)
+    return (sets["SPAN_MARKS"], sets["TRACE_EVENTS"], sets["ANOMALY_KINDS"])
 
 
 def _class_uses_labeled_view(fn) -> bool:

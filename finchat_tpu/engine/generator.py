@@ -40,18 +40,22 @@ class TextGenerator(Protocol):
     # ``conversation_id`` keys the engine's cross-turn session KV cache
     # (engine/session_cache.py); None = no cross-turn reuse. ``deadline``
     # (monotonic time.perf_counter) feeds the scheduler's shed/EDF
-    # admission; None = no deadline. Non-engine implementations may
-    # ignore both.
+    # admission; None = no deadline. ``trace_id`` threads the ingress-
+    # minted end-to-end trace id into the scheduler's span/dispatch
+    # events (utils/tracing.py — ISSUE 12); None = untraced. Non-engine
+    # implementations may ignore all three.
     async def stream(
         self, prompt: str, sampling: SamplingParams,
         conversation_id: str | None = None,
         deadline: float | None = None,
+        trace_id: str | None = None,
     ) -> AsyncIterator[str]: ...
 
     async def generate(
         self, prompt: str, sampling: SamplingParams,
         conversation_id: str | None = None,
         deadline: float | None = None,
+        trace_id: str | None = None,
     ) -> str: ...
 
 
@@ -106,6 +110,7 @@ class EngineGenerator:
         self, prefix_text: str, sampling: SamplingParams,
         conversation_id: str | None = None,
         deadline: float | None = None,
+        trace_id: str | None = None,
     ):
         """Start prefilling a prompt's static prefix while its tail (the
         retrieval graft) is still being computed. Returns an opaque handle
@@ -123,6 +128,7 @@ class EngineGenerator:
         return await self.scheduler.submit_partial(
             f"seq-{next(self._ids)}", prefix_ids, sampling,
             conversation_id=conversation_id, deadline=deadline,
+            trace_id=trace_id,
         )
 
     def release_partial(self, partial) -> None:
@@ -137,6 +143,7 @@ class EngineGenerator:
         conversation_id: str | None = None,
         partial=None,
         deadline: float | None = None,
+        trace_id: str | None = None,
     ) -> AsyncIterator[str]:
         prompt_ids = self.tokenizer.encode(prompt, add_bos=True)
         budget = self.prompt_budget(sampling)
@@ -173,6 +180,7 @@ class EngineGenerator:
             handle = await self.scheduler.submit(
                 seq_id, prompt_ids, sampling, constraint=constraint,
                 conversation_id=conversation_id, deadline=deadline,
+                trace_id=trace_id,
             )
         decoder = IncrementalDecoder(self.tokenizer)
         try:
@@ -204,11 +212,12 @@ class EngineGenerator:
         conversation_id: str | None = None,
         partial=None,
         deadline: float | None = None,
+        trace_id: str | None = None,
     ) -> str:
         return "".join([
             piece async for piece in self.stream(
                 prompt, sampling, conversation_id=conversation_id,
-                partial=partial, deadline=deadline,
+                partial=partial, deadline=deadline, trace_id=trace_id,
             )
         ])
 
@@ -244,6 +253,7 @@ class StubGenerator:
         self, prompt: str, sampling: SamplingParams,
         conversation_id: str | None = None,
         deadline: float | None = None,
+        trace_id: str | None = None,
     ) -> AsyncIterator[str]:
         self.calls.append(prompt)
         if self.fail_with is not None:
@@ -259,5 +269,6 @@ class StubGenerator:
         self, prompt: str, sampling: SamplingParams,
         conversation_id: str | None = None,
         deadline: float | None = None,
+        trace_id: str | None = None,
     ) -> str:
         return "".join([piece async for piece in self.stream(prompt, sampling)])

@@ -96,7 +96,7 @@ from finchat_tpu.engine.sampler import SamplingParams
 from finchat_tpu.utils.faults import inject
 from finchat_tpu.utils.logging import get_logger
 from finchat_tpu.utils.metrics import METRICS, Timer
-from finchat_tpu.utils.tracing import RequestSpan
+from finchat_tpu.utils.tracing import TRACER, RequestSpan
 
 logger = get_logger(__name__)
 
@@ -190,11 +190,15 @@ class SequenceHandle:
     # emitting iteration also ran prefill work)
     last_token_at: float | None = None
     finished: bool = False
+    # end-to-end trace id (utils/tracing.py — ISSUE 12): minted at ingress
+    # (Kafka message_id / HTTP header) and threaded down through the agent
+    # and generator; None = untraced (direct scheduler submissions)
+    trace_id: str | None = None
     span: RequestSpan = None  # type: ignore[assignment]  # set in __post_init__
 
     def __post_init__(self) -> None:
         if self.span is None:
-            self.span = RequestSpan(self.seq_id)
+            self.span = RequestSpan(self.seq_id, trace_id=self.trace_id)
         if not self.history:
             self.history = list(self.prompt_ids)
 
@@ -202,7 +206,8 @@ class SequenceHandle:
         if self.first_token_at is None:
             self.first_token_at = time.perf_counter()
             self.span.mark("first_token")
-            METRICS.observe("finchat_ttft_seconds", self.first_token_at - self.submitted_at)
+            METRICS.observe("finchat_ttft_seconds", self.first_token_at - self.submitted_at,
+                            trace_id=self.trace_id)
 
 
 @dataclass
@@ -342,6 +347,12 @@ class ContinuousBatchingScheduler:
         # headline) is exact, not a racy window over global counters
         self._dispatch_tally = 0
         self._coexist_mark: int | None = None
+        # trace-event track label (utils/tracing.py — ISSUE 12): one
+        # Perfetto track per engine so a fleet's dispatch timelines stay
+        # separable in one export
+        self._trace_track = (
+            f"replica-{replica_id}" if replica_id is not None else "engine"
+        )
         # shared-prefix KV cache: matched at admission so identical prompt
         # heads (the constant system prompt every conversation shares) are
         # prefilled ONCE per process instead of per request — see
@@ -476,6 +487,7 @@ class ContinuousBatchingScheduler:
         constraint: TokenConstraint | None = None,
         conversation_id: str | None = None,
         deadline: float | None = None,
+        trace_id: str | None = None,
     ) -> SequenceHandle:
         if not prompt_ids:
             raise ValueError("empty prompt")
@@ -514,7 +526,7 @@ class ContinuousBatchingScheduler:
         handle = SequenceHandle(
             seq_id=seq_id, prompt_ids=list(prompt_ids), sampling=sampling,
             constraint=constraint, conversation_id=conversation_id,
-            deadline=deadline, owner=self,
+            deadline=deadline, owner=self, trace_id=trace_id,
         )
         self.pending.append(handle)
         self.metrics.set_gauge("finchat_queue_depth", len(self.pending))
@@ -528,6 +540,7 @@ class ContinuousBatchingScheduler:
         sampling: SamplingParams,
         conversation_id: str | None = None,
         deadline: float | None = None,
+        trace_id: str | None = None,
     ) -> SequenceHandle | None:
         """Start prefilling a prompt whose TAIL is not known yet (the
         retrieval/prefill overlap path): ``prefix_ids`` is the static
@@ -550,7 +563,7 @@ class ContinuousBatchingScheduler:
             return None
         handle = await self.submit(
             seq_id, prefix_ids, sampling, conversation_id=conversation_id,
-            deadline=deadline,
+            deadline=deadline, trace_id=trace_id,
         )
         # no await ran between submit() appending to pending and here (the
         # scheduler loop is a separate task), so the hold flags are set
@@ -597,6 +610,21 @@ class ContinuousBatchingScheduler:
         self.metrics.inc("finchat_partial_grafts_total")
         self._wakeup.set()
         return True
+
+    def _trace_dispatch(self, kind: str, rows: list, *,
+                        ts: float | None = None,
+                        dur: float | None = None) -> None:
+        """Record one model dispatch in the trace ring (ISSUE 12): which
+        ``[slot, trace_id, mode]`` rows rode it, so a request's exported
+        timeline shows every dispatch that carried its rows even when many
+        requests share one ragged dispatch. Host data only — the rows come
+        from the membership/descriptor bookkeeping the round already built,
+        so the event adds zero device syncs (finchat-lint R2). Callers
+        guard with ``TRACER.enabled`` so the row list is never built for
+        nothing."""
+        TRACER.event("dispatch", ts=ts, dur=dur, track=self._trace_track,
+                     args={"kind": kind, "n": self._dispatch_tally,
+                           "rows": rows})
 
     def _ring_routed(self, handle: SequenceHandle) -> bool:
         """Does this prefilling handle take the seq-sharded ring path this
@@ -846,6 +874,9 @@ class ContinuousBatchingScheduler:
                     and handle.generated == 0 and not handle.preempted):
                 self.pending.remove(handle)
                 self.metrics.inc("finchat_sheds_total")
+                TRACER.anomaly("shed", handle.trace_id,
+                               args={"seq_id": handle.seq_id,
+                                     "replica": self.replica_id})
                 handle.finished = True
                 handle.span.finish()
                 handle.events.put_nowait({
@@ -1206,6 +1237,10 @@ class ContinuousBatchingScheduler:
         # on top when deadlines are in play
         self.pending.appendleft(handle)
         self.metrics.inc("finchat_preemptions_total")
+        if TRACER.enabled and handle.trace_id is not None:
+            TRACER.event("preempt", handle.trace_id, track=self._trace_track,
+                         args={"preempted": handle.preempted,
+                               "for_rebuild": for_rebuild})
         self.metrics.set_gauge("finchat_queue_depth", len(self.pending))
         self._wakeup.set()
 
@@ -1286,6 +1321,9 @@ class ContinuousBatchingScheduler:
                 and len(self.pending) >= self.max_queue_depth):
             return False
         handle.owner = self  # cleanup (cancel) must target THIS scheduler now
+        if TRACER.enabled and handle.trace_id is not None:
+            TRACER.event("adopt", handle.trace_id, track=self._trace_track,
+                         args={"live": live})
         if live:
             self.pending.appendleft(handle)
         else:
@@ -1633,6 +1671,14 @@ class ContinuousBatchingScheduler:
         self._breaker_bucket = bucket
         self._rebuilds_without_success += 1
         if self._rebuilds_without_success > self.breaker_max_rebuilds:
+            # black box for the give-up drill (ISSUE 12): the ring holds
+            # the tripped rounds' dispatch spans and the failing streams'
+            # lifecycle events at the moment this replica goes OUT
+            TRACER.anomaly("replica_give_up", args={
+                "plane": bucket, "error": str(error)[:200],
+                "replica": self.replica_id,
+                "rebuilds": self._rebuilds_without_success - 1,
+            })
             if self.drain_sink is not None:
                 # fleet give-up (ISSUE 6): the streams survive on siblings
                 # — preempt every live sequence to host (prompt+generated
@@ -1713,6 +1759,16 @@ class ContinuousBatchingScheduler:
         logger.error("breaker tripped (%s): preempting %d live sequences and "
                      "rebuilding engine device state", error,
                      len(self.decoding) + len(self.prefilling))
+        # flight recorder (ISSUE 12): the anomaly event + ring dump capture
+        # the tripped rounds' dispatch spans and every live stream's
+        # lifecycle up to this instant — the black box for the breaker
+        # drill ROBUSTNESS.md scripts. Host bookkeeping only; the dump
+        # itself writes in a worker thread.
+        TRACER.anomaly("breaker_trip", args={
+            "plane": bucket, "error": str(error)[:200],
+            "replica": self.replica_id, "dispatch_tally": self._dispatch_tally,
+            "live": len(self.decoding) + len(self.prefilling),
+        })
         if self._breaker_tripped_at is None:
             self._breaker_tripped_at = time.perf_counter()
         self.metrics.set_gauge("finchat_breaker_state", 1)
@@ -1819,9 +1875,15 @@ class ContinuousBatchingScheduler:
                         # in-flight decode streams stall for the whole
                         # seq-sharded prefill — the latency trade the
                         # chunked path below exists to avoid
-                        with Timer(self.metrics, "finchat_prefill_seconds"):
+                        with Timer(self.metrics, "finchat_prefill_seconds") as _pt:
                             ring_logits = eng.prefill_ring(handle.slot, handle.prompt_ids)
                         self._dispatch_tally += 1
+                        if TRACER.enabled:
+                            self._trace_dispatch(
+                                "ring",
+                                [[handle.slot, handle.trace_id or handle.seq_id, "ring"]],
+                                ts=_pt.started, dur=_pt.elapsed,
+                            )
                         handle.prefill_pos = len(handle.prompt_ids)
                         completions.append((handle, ring_logits, handle.epoch))
                         continue
@@ -1832,11 +1894,17 @@ class ContinuousBatchingScheduler:
                     # attention, engine.prefill_ring_segment)
                     handle.ring_path = True
                     seg = handle.prompt_ids[handle.prefill_pos : handle.prefill_pos + rc]
-                    with Timer(self.metrics, "finchat_prefill_seconds"):
+                    with Timer(self.metrics, "finchat_prefill_seconds") as _pt:
                         seg_logits = eng.prefill_ring_segment(
                             handle.slot, seg, handle.prefill_pos
                         )
                     self._dispatch_tally += 1
+                    if TRACER.enabled:
+                        self._trace_dispatch(
+                            "ring_segment",
+                            [[handle.slot, handle.trace_id or handle.seq_id, "ring"]],
+                            ts=_pt.started, dur=_pt.elapsed,
+                        )
                     handle.prefill_pos += len(seg)
                     if handle.prefill_pos >= len(handle.prompt_ids):
                         completions.append((handle, seg_logits, handle.epoch))
@@ -1857,7 +1925,7 @@ class ContinuousBatchingScheduler:
             rows += [(j.slot, j.ids, j.pos) for j in jobs]
             N = round_up_pow2(len(rows))
             tokens, slots, starts, n_valids = self._pack_prefill_rows(rows, N, C)
-            with Timer(self.metrics, "finchat_prefill_seconds"):
+            with Timer(self.metrics, "finchat_prefill_seconds") as _pt:
                 # host-side dispatch time for the round (device work is
                 # async; steady-state it tracks the round cadence)
                 eng.state, logits = prefill_step(
@@ -1868,6 +1936,11 @@ class ContinuousBatchingScheduler:
                     attn_backend=eng.attn_backend,
                 )
             self._dispatch_tally += 1
+            if TRACER.enabled:
+                trows = [[h.slot, h.trace_id or h.seq_id, "prefill"] for h in batch]
+                trows += [[j.slot, f"prefix:{j.owner}", "prefix"] for j in jobs]
+                self._trace_dispatch("prefill", trows,
+                                     ts=_pt.started, dur=_pt.elapsed)
             for i, handle in enumerate(batch):
                 handle.prefill_pos += int(n_valids[i])
                 if handle.prefill_pos >= len(handle.prompt_ids):
@@ -2149,7 +2222,7 @@ class ContinuousBatchingScheduler:
         T = eng.ragged_bucket(len(packed))
         packed += [0] * (T - len(packed))
         tok_row += [R] * (T - len(tok_row))
-        with Timer(self.metrics, "finchat_mixed_step_seconds"):
+        with Timer(self.metrics, "finchat_mixed_step_seconds") as _mt:
             emitted_dev, n_em_dev, row_logits_dev, block_dev = eng.ragged_mixed(
                 jnp.asarray(np.asarray(packed, np.int32)),
                 jnp.asarray(np.asarray(tok_row, np.int32)),
@@ -2162,6 +2235,23 @@ class ContinuousBatchingScheduler:
                 self.eos_id,
             )
         self._dispatch_tally += 1
+        if TRACER.enabled:
+            # dispatch span piggybacking on the round's own row
+            # bookkeeping (ISSUE 12): every (slot, trace, mode) row that
+            # rode this one ragged dispatch, from host data only
+            trows = [[h.slot, h.trace_id or h.seq_id, "prefill"]
+                     for _i, h in prefill_rows]
+            trows += [[j.slot, f"prefix:{j.owner}", "prefix"]
+                      for _i, j in job_rows]
+            trows += [[slot, h.trace_id or h.seq_id, "constrained"]
+                      for _i, slot, h, _e in constrained_decode]
+            trows += [[slot, h.trace_id or h.seq_id,
+                       "decode_loop" if loop_active[slot] else "decode"]
+                      for _i, slot, h, _e in plain_rows]
+            trows += [[slot, h.trace_id or h.seq_id, "spec"]
+                      for _i, slot, h, _e in spec_rows]
+            self._trace_dispatch("ragged", trows,
+                                 ts=_mt.started, dur=_mt.elapsed)
         # prefill bookkeeping happens at dispatch: row_len is host data
         for idx, h in prefill_rows:
             h.prefill_pos += int(row_len[idx])
@@ -2265,6 +2355,7 @@ class ContinuousBatchingScheduler:
             self.metrics.observe(
                 "finchat_inter_token_seconds", now - handle.last_token_at,
                 labels={"prefill_concurrent": "yes" if self._iter_ran_prefill else "no"},
+                trace_id=handle.trace_id,
             )
         handle.last_token_at = now
         handle._emit_first_token_metrics()
@@ -2328,6 +2419,12 @@ class ContinuousBatchingScheduler:
             return_logits=need_logits,
         )
         self._dispatch_tally += 1
+        if TRACER.enabled:
+            self._trace_dispatch(
+                "decode",
+                [[slot, h.trace_id or h.seq_id, "decode"]
+                 for slot, h, _e in members],
+            )
         next_tokens, logits = result if need_logits else (result, None)
         if logits is not None:
             logits = logits[jnp.asarray(constrained_slots, jnp.int32)]
@@ -2420,6 +2517,12 @@ class ContinuousBatchingScheduler:
             eos_id=self.eos_id,
         )
         self._dispatch_tally += 1
+        if TRACER.enabled:
+            self._trace_dispatch(
+                "decode_loop",
+                [[slot, h.trace_id or h.seq_id, "decode_loop"]
+                 for slot, h, _e in block_members],
+            )
         self.metrics.inc("finchat_decode_loop_blocks_total")
         self.metrics.set_gauge("finchat_decode_loop_demoted_slots", len(demoted))
         step = None
@@ -2559,6 +2662,12 @@ class ContinuousBatchingScheduler:
             return_logits=need_logits,
         )
         self._dispatch_tally += 1
+        if TRACER.enabled:
+            self._trace_dispatch(
+                "spec",
+                [[slot, h.trace_id or h.seq_id, "spec"]
+                 for slot, h, _e in members],
+            )
         emitted, n_emitted, logits = result if need_logits else (*result, None)
         if logits is not None:
             logits = logits[jnp.asarray(constrained_slots, jnp.int32)]

@@ -72,6 +72,7 @@ import numpy as np
 from finchat_tpu.utils.faults import inject
 from finchat_tpu.utils.logging import get_logger
 from finchat_tpu.utils.metrics import METRICS
+from finchat_tpu.utils.tracing import TRACER
 
 logger = get_logger(__name__)
 
@@ -460,6 +461,11 @@ class SessionDiskTier:
             except OSError:
                 src.unlink(missing_ok=True)
         self.metrics.inc("finchat_durability_quarantines_total")
+        # flight recorder (ISSUE 12): a corrupt record is silent data loss
+        # from the client's point of view (cold resume) — the black box
+        # records which key, when, and what the serving plane was doing
+        TRACER.anomaly("record_quarantine",
+                       args={"key": key, "file": fname})
         self._publish_gauges()
 
     # --- startup ---------------------------------------------------------

@@ -34,6 +34,7 @@ from __future__ import annotations
 import asyncio
 import json
 import time
+import uuid
 from pathlib import Path
 
 import jax
@@ -65,6 +66,7 @@ from finchat_tpu.utils.config import (
 )
 from finchat_tpu.utils.logging import get_logger
 from finchat_tpu.utils.metrics import METRICS
+from finchat_tpu.utils.tracing import TRACER
 
 logger = get_logger(__name__)
 
@@ -373,6 +375,10 @@ class App:
         self.server = HTTPServer(cfg.serve.host, cfg.serve.port)
         self.server.route("GET", "/health", self.health)
         self.server.route("GET", "/metrics", self.metrics)
+        # end-to-end request tracing (utils/tracing.py — ISSUE 12): one
+        # request's correlated Kafka-ingress→dispatch timeline as Chrome
+        # trace-event JSON (open in Perfetto)
+        self.server.route_prefix("GET", "/debug/trace/", self.debug_trace)
         self.server.route("POST", "/chat", self.chat)
         self.server.route("POST", "/chat/stream", self.chat_stream)
         self.server.route("POST", "/transactions", self.upsert_transactions)
@@ -525,6 +531,10 @@ class App:
         conversations warm from the disk tier."""
         t0 = time.perf_counter()
         METRICS.inc("finchat_durability_graceful_drains_total")
+        # black box of the shutdown itself (ISSUE 12): what was in flight
+        # when SIGTERM landed; flushed to disk before the process exits
+        TRACER.anomaly("sigterm_drain",
+                       args={"inflight": len(self._inflight)})
         self._draining = True
         self._running = False
         if self._consume_task:
@@ -568,6 +578,9 @@ class App:
             "finchat_durability_shutdown_drain_seconds",
             time.perf_counter() - t0,
         )
+        # the flight dumps write in worker threads; join them (off-loop)
+        # so the black box is on disk before the process exits
+        await asyncio.to_thread(TRACER.flush_dumps)
         await self.stop()
 
     # snapshots are full rewrites (np.savez over the whole collection), so
@@ -728,12 +741,56 @@ class App:
             user_id = payload["user_id"]
         return conversation_id, user_id, user_context, chat_history
 
+    # --- tracing (utils/tracing.py — ISSUE 12) --------------------------
+    @staticmethod
+    def _kafka_trace_id(message_value: dict | None) -> str | None:
+        """The trace id a Kafka message carries BY ITSELF: its
+        ``message_id`` (the same id the answered journal and dedupe ring
+        key on). None when the producer stamped no id — the handler then
+        mints one, which correlation-at-the-watchdog can't recover (the
+        watchdog only holds the raw message)."""
+        if message_value is None:
+            return None
+        mid = message_value.get("message_id")
+        return str(mid) if mid is not None else None
+
+    @staticmethod
+    def _http_trace_id(request: Request) -> str:
+        """HTTP ingress trace id: the client's ``x-trace-id`` header when
+        given (so an upstream gateway's id correlates end-to-end), else
+        minted here."""
+        return request.headers.get("x-trace-id") or uuid.uuid4().hex[:16]
+
+    @staticmethod
+    def _trace_ingress(trace_id: str, source: str, conversation_id: str) -> None:
+        if TRACER.enabled:
+            TRACER.event("ingress", trace_id, track="ingress",
+                         args={"source": source,
+                               "conversation_id": conversation_id})
+
     # --- HTTP handlers --------------------------------------------------
     async def health(self, request: Request) -> Response:
         return Response.json({"status": "healthy"})
 
     async def metrics(self, request: Request) -> Response:
         return Response.text(METRICS.render_prometheus(), content_type="text/plain; version=0.0.4")
+
+    async def debug_trace(self, request: Request) -> Response:
+        """``GET /debug/trace/<trace_id>`` → Chrome trace-event JSON of
+        that request's correlated timeline (ingress, agent decide, tool
+        launch/adopt, prefill, every dispatch that carried its rows,
+        first token, done). Open the body in Perfetto / chrome://tracing."""
+        trace_id = request.path.rsplit("/", 1)[-1]
+        if not trace_id:
+            return Response.json({"detail": "missing trace id"}, status=400)
+        export = TRACER.export(trace_id)
+        if not export["traceEvents"]:
+            return Response.json(
+                {"detail": f"no events for trace_id {trace_id!r} "
+                           "(expired from the ring, or never traced)"},
+                status=404,
+            )
+        return Response.json(export)
 
     async def chat(self, request: Request) -> Response:
         """Batch REST path (the reference's commented POST /process_message,
@@ -745,6 +802,8 @@ class App:
         conversation_id, user_id, user_context, chat_history = (
             await self._conversation_inputs(payload)
         )
+        trace_id = self._http_trace_id(request)
+        self._trace_ingress(trace_id, "http:/chat", conversation_id)
         try:
             agent = self._agent_for(conversation_id)
         except RuntimeError:
@@ -757,6 +816,7 @@ class App:
             payload["message"], user_id, user_context, chat_history,
             conversation_id=conversation_id,
             deadline=self._request_deadline(),
+            trace_id=trace_id,
         )
         body = {
             "response": result["response"],
@@ -777,6 +837,8 @@ class App:
         )
 
         deadline = self._request_deadline()
+        trace_id = self._http_trace_id(request)
+        self._trace_ingress(trace_id, "http:/chat/stream", conversation_id)
         try:
             agent = self._agent_for(conversation_id)
         except RuntimeError:
@@ -789,6 +851,7 @@ class App:
             updates = agent.stream_with_status(
                 payload["message"], user_id, user_context, chat_history,
                 conversation_id=conversation_id, deadline=deadline,
+                trace_id=trace_id,
             )
             # decode_loop bursts re-pace through the SAME per-chunk emit —
             # clients see a smooth token cadence, not K-frame stutters
@@ -886,6 +949,13 @@ class App:
             )
             return False
 
+        # trace id minted at ingress (ISSUE 12): the Kafka message_id when
+        # the producer stamped one — the SAME id the journal/dedupe plane
+        # keys on, so a postmortem can pivot between the answered journal
+        # and the timeline — else minted here
+        trace_id = self._kafka_trace_id(message_value) or uuid.uuid4().hex[:16]
+        self._trace_ingress(trace_id, f"kafka:{USER_MESSAGE_TOPIC}",
+                            conversation_id)
         # deadline anchored at the PRODUCER timestamp: broker queueing time
         # counts against the allowance, so a message that sat through a
         # backlog sheds (structured retryable error) instead of burning
@@ -893,6 +963,7 @@ class App:
         updates = agent.stream_with_status(
             msg, user_id, context, chat_history, conversation_id=conversation_id,
             deadline=self._request_deadline(self._message_wall_ts(message)),
+            trace_id=trace_id,
         )
         try:
             async for update in updates:
@@ -980,6 +1051,15 @@ class App:
             return bool(await asyncio.wait_for(asyncio.shield(task), timeout=watchdog))
         except asyncio.TimeoutError:
             logger.error("Message processing timed out after %s seconds", watchdog)
+            # flight recorder (ISSUE 12): the ring at this instant holds
+            # the stuck request's dispatch/lifecycle events — exactly what
+            # a "why did the watchdog fire" postmortem needs
+            TRACER.anomaly(
+                "watchdog_timeout", self._kafka_trace_id(message_value),
+                args={"watchdog_seconds": watchdog,
+                      "conversation_id": (message_value or {}).get(
+                          "conversation_id")},
+            )
             # cancel the in-flight generation and AWAIT its cleanup — the
             # agent/generator finalizers release the scheduler slot and KV
             # pages — BEFORE emitting the timeout chunk, so a timed-out
@@ -1134,6 +1214,12 @@ def build_app(cfg: AppConfig | None = None, *, store: ConversationStore | None =
     from finchat_tpu.utils.config import load_config
 
     cfg = cfg or load_config()
+    # tracing + flight recorder (utils/tracing.py — ISSUE 12): applied at
+    # assembly so every component (scheduler, agent, app ingress) sees one
+    # consistently configured process tracer
+    TRACER.configure(enabled=cfg.tracing.enabled,
+                     ring_events=cfg.tracing.ring_events,
+                     flight_dir=cfg.tracing.flight_dir)
     store = store or make_store(cfg.store)
     kafka = kafka or KafkaClient(cfg.kafka)
 

@@ -69,6 +69,7 @@ from finchat_tpu.io.kafka import DEFAULT_NUM_PARTITIONS, partition_for_key
 from finchat_tpu.utils.config import FleetConfig
 from finchat_tpu.utils.logging import get_logger
 from finchat_tpu.utils.metrics import METRICS
+from finchat_tpu.utils.tracing import TRACER
 
 logger = get_logger(__name__)
 
@@ -306,6 +307,11 @@ class EngineFleet:
             s_cache.discard(key)
             if imported:
                 self.metrics.inc("finchat_fleet_session_migrations_total")
+                if TRACER.enabled:
+                    TRACER.event("session_migrate", track="fleet",
+                                 args={"key": key,
+                                       "source": rep.replica_id,
+                                       "target": target.replica_id})
                 logger.info("fleet: migrated session %s %s→%s (%d tokens)",
                             key, rep.replica_id, target.replica_id,
                             payload["token_ids"].shape[0])
@@ -351,6 +357,11 @@ class EngineFleet:
                     logger.error("session handoff to %s failed for %s: %s",
                                  target.replica_id, key, e)
             self.metrics.inc("finchat_fleet_drained_streams_total")
+            trace_id = getattr(handle, "trace_id", None)  # test doubles
+            if TRACER.enabled and trace_id is not None:
+                TRACER.event("drain_handoff", trace_id, track="fleet",
+                             args={"source": source.replica_id,
+                                   "target": target.replica_id})
             logger.info("fleet: drained %s (%s) %s→%s", handle.seq_id, key,
                         source.replica_id, target.replica_id)
             return True

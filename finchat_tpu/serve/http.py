@@ -70,10 +70,19 @@ class HTTPServer:
         self.host = host
         self.port = port
         self._routes: dict[tuple[str, str], Handler] = {}
+        # (method, prefix) -> handler, matched after exact routes for
+        # path-parameter endpoints like GET /debug/trace/<trace_id>
+        self._prefix_routes: list[tuple[str, str, Handler]] = []
         self._server: asyncio.AbstractServer | None = None
 
     def route(self, method: str, path: str, handler: Handler) -> None:
         self._routes[(method.upper(), path)] = handler
+
+    def route_prefix(self, method: str, prefix: str, handler: Handler) -> None:
+        """Register a prefix-matched route; the handler reads the path
+        suffix off ``request.path`` (longest prefix wins)."""
+        self._prefix_routes.append((method.upper(), prefix, handler))
+        self._prefix_routes.sort(key=lambda r: len(r[1]), reverse=True)
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(self._handle_conn, self.host, self.port)
@@ -131,6 +140,11 @@ class HTTPServer:
                 writer.write(self._head(400, "text/plain", length=0))
                 return
             handler = self._routes.get((request.method, request.path))
+            if handler is None:
+                for method, prefix, h in self._prefix_routes:
+                    if method == request.method and request.path.startswith(prefix):
+                        handler = h
+                        break
             if handler is None:
                 if any(path == request.path for _, path in self._routes):
                     writer.write(self._head(405, "text/plain", length=0))

@@ -423,6 +423,29 @@ class ShutdownConfig:
 
 
 @dataclass
+class TracingConfig:
+    """End-to-end request tracing + anomaly flight recorder
+    (utils/tracing.py — ISSUE 12; OBSERVABILITY.md)."""
+
+    # record structured trace events (span marks, dispatch rows, fleet
+    # moves) into the bounded per-process ring and serve
+    # GET /debug/trace/<trace_id>; events stamp from host data only, so
+    # the decode hot path pays < 2% with this on (bench --trace-overhead
+    # gates it). Also FINCHAT_TRACING.
+    enabled: bool = True
+    # ring capacity in events — bounds tracing memory (~100 bytes/event);
+    # the flight recorder dumps exactly this window on anomaly. Also
+    # FINCHAT_TRACING_RING_EVENTS.
+    ring_events: int = 65536
+    # flight-recorder directory: on anomaly (breaker trip, watchdog fire,
+    # shed, replica give-up, record quarantine, SIGTERM drain) the ring is
+    # dumped to a checksummed file here, alongside the anomaly's own
+    # event. "" = flight recorder off (events still ring-buffer). Also
+    # FINCHAT_TRACING_FLIGHT_DIR, CLI --flight-dir.
+    flight_dir: str = ""
+
+
+@dataclass
 class ServeConfig:
     host: str = "0.0.0.0"
     port: int = 8000
@@ -440,6 +463,7 @@ class AppConfig:
     fleet: FleetConfig = field(default_factory=FleetConfig)
     journal: JournalConfig = field(default_factory=JournalConfig)
     shutdown: ShutdownConfig = field(default_factory=ShutdownConfig)
+    tracing: TracingConfig = field(default_factory=TracingConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
 
     def to_dict(self) -> dict[str, Any]:
@@ -581,6 +605,13 @@ def load_config(
         "FINCHAT_SHUTDOWN_DEADLINE_SECONDS", cfg.shutdown.deadline_seconds
     )
     cfg.kafka.offsets_dir = _env("FINCHAT_KAFKA_OFFSETS_DIR", cfg.kafka.offsets_dir)
+    cfg.tracing.enabled = _env_bool("FINCHAT_TRACING", cfg.tracing.enabled)
+    cfg.tracing.ring_events = _env_int(
+        "FINCHAT_TRACING_RING_EVENTS", cfg.tracing.ring_events
+    )
+    cfg.tracing.flight_dir = _env(
+        "FINCHAT_TRACING_FLIGHT_DIR", cfg.tracing.flight_dir
+    )
     cfg.engine.retrieval_overlap = _env_bool(
         "FINCHAT_RETRIEVAL_OVERLAP", cfg.engine.retrieval_overlap
     )

@@ -117,6 +117,17 @@ full prompt onto a hold), ``finchat_partial_fallbacks_total`` (graft
 would have invalidated prefilled KV — serial fallback), and
 ``finchat_partial_stale_reaps_total`` (abandoned holds reclaimed).
 
+Tracing family (utils/tracing.py — ISSUE 12):
+``finchat_span_double_finish_total`` (RequestSpan.finish called again
+after the first — idempotent by contract, the counter is the exposure
+meter for the preempt-replay / drain-handoff overlap paths) and
+``finchat_flight_dumps_total{reason=...}`` (anomaly flight-recorder
+dumps written, per anomaly kind). Histograms additionally carry
+EXEMPLARS: ``observe(..., trace_id=...)`` keeps the last trace id whose
+value landed at/above the p99 bucket, rendered as an OpenMetrics-style
+comment after the family and readable via ``exemplar()`` — a latency
+spike links straight to ``GET /debug/trace/<trace_id>``.
+
 Tool-streaming family (agent/streamparse.py — ISSUE 9; per engine/replica
 via the agent's labeled view like every per-engine family):
 ``finchat_tool_launches_total`` (speculative + adopted tool executions
@@ -156,7 +167,16 @@ def _split_key(key: str) -> tuple[str, str]:
 
 @dataclass
 class _Histogram:
-    """Fixed-bucket histogram (seconds-scale by default)."""
+    """Fixed-bucket histogram (seconds-scale by default).
+
+    With a ``trace_id`` passed to ``observe``, the histogram keeps an
+    EXEMPLAR — the last trace id whose value landed strictly above the
+    p99 bucket (the first traced observation seeds it) — so a latency
+    spike on a dashboard links straight to that request's exported
+    timeline (``/debug/trace/<trace_id>``; ISSUE 12). Bucket-resolution
+    "above p99" by design — the exact p99 is not known from bucket
+    counts, and the exemplar only has to point at a representative slow
+    request."""
 
     buckets: tuple[float, ...] = (
         0.005, 0.01, 0.025, 0.05, 0.1, 0.2, 0.3, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 100.0,
@@ -164,19 +184,41 @@ class _Histogram:
     counts: list[int] = field(default_factory=list)
     total: float = 0.0
     n: int = 0
+    # (trace_id, value, unix_ts) of the last above-p99 observation
+    exemplar: tuple[str, float, float] | None = None
 
     def __post_init__(self) -> None:
         if not self.counts:
             self.counts = [0] * (len(self.buckets) + 1)
 
-    def observe(self, value: float) -> None:
-        self.total += value
-        self.n += 1
+    def _bucket_index(self, value: float) -> int:
         for i, edge in enumerate(self.buckets):
             if value <= edge:
-                self.counts[i] += 1
-                return
-        self.counts[-1] += 1
+                return i
+        return len(self.buckets)
+
+    def _q_index(self, q: float) -> int:
+        """Index of the bucket containing the q-quantile."""
+        target = q * self.n
+        seen = 0
+        for i in range(len(self.counts)):
+            seen += self.counts[i]
+            if seen >= target:
+                return i
+        return len(self.counts) - 1
+
+    def observe(self, value: float, trace_id: str | None = None) -> None:
+        self.total += value
+        self.n += 1
+        idx = self._bucket_index(value)
+        self.counts[idx] += 1
+        if trace_id is not None:
+            # strictly ABOVE the p99 bucket: when 99% of mass sits in one
+            # bucket, observations inside it must not churn the exemplar
+            # away from the genuine outlier. The first traced observation
+            # seeds it so the family always links somewhere.
+            if self.exemplar is None or idx > self._q_index(0.99):
+                self.exemplar = (trace_id, value, time.time())
 
     def quantile(self, q: float) -> float:
         """Approximate quantile from bucket edges (upper bound of the bucket)."""
@@ -211,12 +253,22 @@ class MetricsRegistry:
             self._gauges[_labeled_key(name, labels)] = value
 
     def observe(self, name: str, value: float,
-                labels: dict[str, str] | None = None) -> None:
+                labels: dict[str, str] | None = None,
+                trace_id: str | None = None) -> None:
         key = _labeled_key(name, labels)
         with self._lock:
             if key not in self._histograms:
                 self._histograms[key] = _Histogram()
-            self._histograms[key].observe(value)
+            self._histograms[key].observe(value, trace_id=trace_id)
+
+    def exemplar(self, name: str,
+                 labels: dict[str, str] | None = None) -> tuple[str, float, float] | None:
+        """The histogram's last above-p99 ``(trace_id, value, unix_ts)``
+        exemplar, or None (ISSUE 12 — a metrics spike links to a
+        timeline)."""
+        with self._lock:
+            hist = self._histograms.get(_labeled_key(name, labels))
+            return hist.exemplar if hist else None
 
     def get(self, name: str, labels: dict[str, str] | None = None) -> float:
         key = _labeled_key(name, labels)
@@ -291,6 +343,21 @@ class MetricsRegistry:
                 lines.append(f"{base}_bucket{series(le_inf)} {cumulative}")
                 lines.append(f"{base}_sum{series()} {h.total}")
                 lines.append(f"{base}_count{series()} {h.n}")
+                if h.exemplar is not None:
+                    # OpenMetrics-style exemplar surfaced as a comment so
+                    # plain Prometheus 0.0.4 parsers skip it while humans
+                    # (and the verify drives) can jump from a spiked
+                    # family to `/debug/trace/<trace_id>` (ISSUE 12).
+                    # The trace id is CLIENT-CONTROLLED (Kafka message_id
+                    # / x-trace-id header) — escape it so an embedded
+                    # newline/quote can't terminate the comment and forge
+                    # a metric line into the exposition
+                    tid, val, ts = h.exemplar
+                    safe = (tid.replace("\\", "\\\\").replace('"', '\\"')
+                            .replace("\n", "\\n").replace("\r", "\\r"))
+                    lines.append(
+                        f'# exemplar {key} trace_id="{safe}" value={val} ts={ts}'
+                    )
         return "\n".join(lines) + "\n"
 
 
@@ -321,8 +388,14 @@ class LabeledMetrics:
         self._registry.set_gauge(name, value, labels=self._merge(labels))
 
     def observe(self, name: str, value: float,
-                labels: dict[str, str] | None = None) -> None:
-        self._registry.observe(name, value, labels=self._merge(labels))
+                labels: dict[str, str] | None = None,
+                trace_id: str | None = None) -> None:
+        self._registry.observe(name, value, labels=self._merge(labels),
+                               trace_id=trace_id)
+
+    def exemplar(self, name: str,
+                 labels: dict[str, str] | None = None) -> tuple[str, float, float] | None:
+        return self._registry.exemplar(name, labels=self._merge(labels))
 
     def get(self, name: str, labels: dict[str, str] | None = None) -> float:
         return self._registry.get(name, labels=self._merge(labels))
@@ -346,7 +419,8 @@ class Timer:
         self.elapsed = 0.0
 
     def __enter__(self) -> "Timer":
-        self._start = time.perf_counter()
+        self.started = time.perf_counter()
+        self._start = self.started
         return self
 
     def __exit__(self, *exc: object) -> None:

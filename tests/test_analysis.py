@@ -496,6 +496,120 @@ def test_r5_mixed_labeled_unlabeled_family(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# R5 span discipline (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+_MINI_TRACING = """
+    SPAN_MARKS = frozenset({"admitted", "first_token", "done"})
+    TRACE_EVENTS = frozenset({"dispatch", "ingress"})
+    ANOMALY_KINDS = frozenset({"breaker_trip", "shed"})
+"""
+
+
+def test_r5_span_mark_must_be_registered(tmp_path):
+    """A typo'd mark name silently vanishes from every timeline — the
+    span-discipline check catches it statically against SPAN_MARKS."""
+    src = """
+        class H:
+            def go(self, handle):
+                handle.span.mark("admited")      # typo: flagged
+                handle.span.mark("admitted")     # registered: fine
+                self.span.mark("first_token")    # registered: fine
+    """
+    res = _lint(
+        tmp_path,
+        {"finchat_tpu/utils/tracing.py": _MINI_TRACING,
+         "finchat_tpu/sched.py": src},
+        {"metrics-discipline"},
+    )
+    assert len(res.findings) == 1
+    assert "admited" in res.findings[0].message
+    assert "SPAN_MARKS" in res.findings[0].message
+
+
+def test_r5_tracer_event_and_anomaly_names(tmp_path):
+    src = """
+        from finchat_tpu.utils.tracing import TRACER
+
+        def go():
+            TRACER.event("dispatch", "t1")        # registered event
+            TRACER.event("admitted", "t1")        # span marks count too
+            TRACER.event("dispach")               # typo: flagged
+            TRACER.anomaly("breaker_trip")        # registered anomaly
+            TRACER.anomaly("dispatch")            # not an ANOMALY kind: flagged
+    """
+    res = _lint(
+        tmp_path,
+        {"finchat_tpu/utils/tracing.py": _MINI_TRACING,
+         "finchat_tpu/app.py": src},
+        {"metrics-discipline"},
+    )
+    msgs = _messages(res)
+    assert len(msgs) == 2
+    assert any("dispach" in m for m in msgs)
+    assert any("ANOMALY_KINDS" in m for m in msgs)
+
+
+def test_r5_trace_forwarding_helper_literals_checked(tmp_path):
+    """The agent's ``_trace(state, "name")`` forwarding convention: the
+    literal is checked at the helper CALL site (the helper's own
+    non-literal pass-through to TRACER.event is exempt by construction)."""
+    src = """
+        from finchat_tpu.utils.tracing import TRACER
+
+        class Agent:
+            def _trace(self, state, name, **args):
+                TRACER.event(name, state.trace_id)   # non-literal: exempt
+
+            def decide(self, state):
+                self._trace(state, "admitted")       # registered: fine
+                self._trace(state, "decide_startt")  # typo: flagged
+    """
+    res = _lint(
+        tmp_path,
+        {"finchat_tpu/utils/tracing.py": _MINI_TRACING,
+         "finchat_tpu/agent.py": src},
+        {"metrics-discipline"},
+    )
+    assert len(res.findings) == 1
+    assert "decide_startt" in res.findings[0].message
+
+
+def test_r5_span_checks_skip_without_tracing_module(tmp_path):
+    src = """
+        def go(handle):
+            handle.span.mark("anything_goes")
+    """
+    res = _lint(tmp_path, {"finchat_tpu/x.py": src}, {"metrics-discipline"})
+    assert res.findings == []
+
+
+def test_r2_composes_with_tracing_calls_in_hot_regions(tmp_path):
+    """ISSUE 12 satellite: tracing calls inside ``# finchat-lint: hot``
+    regions must not smuggle device reads — a device value cast inside a
+    TRACER.event args dict is exactly the hidden sync R2 exists for.
+    Host-data-only tracing passes."""
+    src = """
+        import jax.numpy as jnp
+        from finchat_tpu.utils.tracing import TRACER
+
+        def dispatch_bad(active):  # finchat-lint: hot
+            tokens = jnp.argmax(active)
+            TRACER.event("dispatch", args={"tok": int(tokens)})
+
+        def dispatch_ok(slot_list, tally):  # finchat-lint: hot
+            TRACER.event("dispatch", args={"rows": slot_list, "n": tally})
+    """
+    res = _lint(
+        tmp_path,
+        {"finchat_tpu/hot.py": src},
+        {"hot-path-host-sync"},
+    )
+    assert len(res.findings) == 1
+    assert res.findings[0].symbol.endswith("dispatch_bad")
+
+
+# ---------------------------------------------------------------------------
 # suppressions + baseline + CLI
 # ---------------------------------------------------------------------------
 
