@@ -141,6 +141,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ragged-smoke", action="store_true",
                    help="tiny --ragged-sweep variant for CI: fewer episodes, "
                         "shorter prompts")
+    p.add_argument("--freerun-sweep", action="store_true",
+                   help="CPU-runnable benchmark of the free-running device "
+                        "loop (ISSUE 13): a loaded mini engine (decode "
+                        "streams + long prompts admitted mid-decode) at "
+                        "freerun_rounds 1/4/8 — captured multi-round "
+                        "dispatches vs host-stepped rounds. Reports model "
+                        "dispatches per ROUND via the scheduler-attributed "
+                        "coexist counters (1.0 -> <1 at rounds >= 4), "
+                        "inter-token p99 delta during the admission window, "
+                        "byte-identity across every level, and a zero-leak "
+                        "audit")
+    p.add_argument("--freerun-smoke", action="store_true",
+                   help="tiny --freerun-sweep variant for CI: rounds 1/4, "
+                        "fewer episodes, dispatch-ratio+identity gates")
     p.add_argument("--chaos-sweep", action="store_true",
                    help="CPU-runnable chaos benchmark of the resilience "
                         "plane (ISSUE 5): greedy streams under injected "
@@ -256,6 +270,8 @@ def run_worker(args: argparse.Namespace) -> int:
         )
     elif args.ragged_sweep or args.ragged_smoke:
         result = measure_ragged_sweep(smoke=args.ragged_smoke)
+    elif args.freerun_sweep or args.freerun_smoke:
+        result = measure_freerun_sweep(smoke=args.freerun_smoke)
     elif args.mixed_sweep:
         result = measure_mixed_sweep(smoke=args.mixed_smoke)
     elif args.tool_overlap_sweep or args.tool_overlap_smoke:
@@ -1698,6 +1714,201 @@ def measure_ragged_sweep(smoke: bool = False) -> dict:
     }
 
 
+def measure_freerun_sweep(smoke: bool = False) -> dict:
+    """Benchmark the free-running device loop (ISSUE 13), CPU-runnable
+    through the REAL scheduler.
+
+    Workload — a loaded engine where prefill and decode coexist for a
+    sustained window: greedy decode streams with deep budgets, a
+    multi-chunk long prompt admitted mid-decode per episode, fused loop
+    tails on (decode_loop_depth 2). Measured at ``freerun_rounds`` 1
+    (host-stepped: one ragged dispatch per round, the PR 10 state of the
+    world) and 4/8 (captured multi-round programs):
+
+    - model dispatches per ROUND via the scheduler-attributed coexist
+      counters (finchat_coexist_dispatches_total over the new
+      finchat_coexist_rounds_total — the ISSUE 13 headline: 1.0 at
+      host-stepped, < 1 once captures engage, approaching 1/rounds);
+    - the decode streams' host-observed inter-token p99 inside each
+      admission window (captures trade per-token cadence for fewer
+      syncs; the ring drains re-pace downstream);
+    - greedy byte-identity of every stream across every level (fp32, the
+      PR 4/10 contract — a staging bug cannot hide behind rounding);
+    - a zero-leak audit of each stopped scheduler.
+    """
+    import asyncio
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from finchat_tpu.analysis.sanitizers import scheduler_leak_report
+    from finchat_tpu.engine.engine import InferenceEngine
+    from finchat_tpu.engine.kv_cache import pages_needed
+    from finchat_tpu.engine.sampler import SamplingParams
+    from finchat_tpu.engine.scheduler import ContinuousBatchingScheduler
+    from finchat_tpu.models.llama import PRESETS, init_params
+    from finchat_tpu.utils.config import EngineConfig
+    from finchat_tpu.utils.metrics import METRICS
+
+    config = dataclasses.replace(PRESETS["mini"], dtype=jnp.float32)
+    page_size = 16
+    chunk = 32
+    long_chunks = 4 if smoke else 8
+    long_len = chunk * long_chunks + 3
+    dec_budget = 40 if smoke else 72
+    long_budget = 8
+    n_dec = 2
+    episodes = 1 if smoke else 2
+    levels = (1, 4) if smoke else (1, 4, 8)
+    max_seq_len = long_len + 8 * page_size
+    pps = pages_needed(max_seq_len, page_size)
+    rng = np.random.default_rng(0)
+    dec_prompts = [
+        rng.integers(1, config.vocab_size, size=n).tolist() for n in (12, 18)
+    ]
+    long_prompt = rng.integers(1, config.vocab_size, size=long_len).tolist()
+    window_keys = (
+        "finchat_coexist_iterations_total",
+        "finchat_coexist_dispatches_total",
+        "finchat_coexist_rounds_total",
+        "finchat_freerun_dispatches_total",
+        "finchat_mixed_dispatches_total",
+    )
+
+    def run(freerun: int) -> dict:
+        ecfg = EngineConfig(
+            max_seqs=4, page_size=page_size, num_pages=4 * pps + 8,
+            max_seq_len=max_seq_len, prefill_chunk=chunk, mixed_step=True,
+            session_cache=False, decode_loop_depth=2, freerun_rounds=freerun,
+        )
+        engine = InferenceEngine(config, init_params(config, jax.random.key(0)), ecfg)
+        engine.warmup()  # compiles (incl. the capture) excluded from windows
+        sched = ContinuousBatchingScheduler(engine, eos_id=-1)
+        win = {k: 0.0 for k in window_keys}
+        gaps: list = []
+
+        async def drain(handle, out):
+            while True:
+                ev = await handle.events.get()
+                if ev["type"] == "token":
+                    out.append((time.perf_counter(), ev["token_id"]))
+                elif ev["type"] == "done":
+                    return
+                else:
+                    raise RuntimeError(str(ev))
+
+        async def go():
+            all_streams = []
+            await sched.start()
+            try:
+                for ep in range(episodes + 1):  # episode 0 warms steady state
+                    handles = [
+                        await sched.submit(
+                            f"dec{ep}-{i}", dec_prompts[i],
+                            SamplingParams(temperature=0.0, max_new_tokens=dec_budget),
+                        )
+                        for i in range(n_dec)
+                    ]
+                    outs = [[] for _ in handles]
+                    tasks = [asyncio.create_task(drain(h, o))
+                             for h, o in zip(handles, outs)]
+                    while any(len(o) < 2 for o in outs):
+                        await asyncio.sleep(0.002)
+                    snap0 = METRICS.snapshot()
+                    t_submit = time.perf_counter()
+                    lh = await sched.submit(
+                        f"long{ep}", long_prompt,
+                        SamplingParams(temperature=0.0, max_new_tokens=long_budget),
+                    )
+                    lo: list = []
+                    ltask = asyncio.create_task(drain(lh, lo))
+                    await asyncio.gather(*tasks, ltask)
+                    # attribution lands at the NEXT loop tick (the PR 10
+                    # mark/attribute pair) — give it one
+                    await asyncio.sleep(0.05)
+                    snap1 = METRICS.snapshot()
+                    if ep == 0:
+                        continue
+                    for k in window_keys:
+                        win[k] += snap1.get(k, 0) - snap0.get(k, 0)
+                    t_first = lo[0][0] if lo else t_submit
+                    for o in outs:
+                        ts = [t for t, _ in o if t_submit <= t <= t_first]
+                        gaps.extend(np.diff(ts).tolist())
+                    all_streams.append(
+                        [[t for _, t in o] for o in outs] + [[t for _, t in lo]]
+                    )
+                return all_streams
+            finally:
+                await sched.stop()
+
+        streams = asyncio.run(go())
+        leaks = scheduler_leak_report(sched)
+        rounds = max(win["finchat_coexist_rounds_total"], 1.0)
+        return {
+            "streams": streams,
+            "dpr": win["finchat_coexist_dispatches_total"] / rounds,
+            "window": {k: int(v) for k, v in win.items()},
+            "gaps": gaps,
+            "leaks": leaks,
+            "warmup_variants": engine.compiled_variants,
+        }
+
+    results = {f: run(f) for f in levels}
+
+    def pct(gaps: list, q: float) -> float:
+        if not gaps:
+            return 0.0
+        return round(1000 * float(np.quantile(np.asarray(gaps), q)), 3)
+
+    base = results[levels[0]]
+    top = results[levels[-1]]
+    identical = all(r["streams"] == base["streams"] for r in results.values())
+    sweep = [
+        {
+            "freerun_rounds": f,
+            "dispatches_per_round": round(r["dpr"], 3),
+            "freerun_dispatches": r["window"]["finchat_freerun_dispatches_total"],
+            "coexist_rounds": r["window"]["finchat_coexist_rounds_total"],
+            "coexist_dispatches": r["window"]["finchat_coexist_dispatches_total"],
+            "intertoken_p50_ms": pct(r["gaps"], 0.5),
+            "intertoken_p99_ms": pct(r["gaps"], 0.99),
+        }
+        for f, r in results.items()
+    ]
+    print(f"[bench] freerun sweep: dispatches/round "
+          + " -> ".join(f"{s['dispatches_per_round']:.2f}@{s['freerun_rounds']}"
+                        for s in sweep)
+          + f"; admission inter-token p99 {pct(base['gaps'], 0.99)}"
+          + f" -> {pct(top['gaps'], 0.99)} ms; identical={identical}",
+          file=sys.stderr, flush=True)
+
+    return {
+        "metric": "freerun_sweep",
+        "unit": "dispatches/round, inter-token ms",
+        "smoke": smoke,
+        "model": "mini (fp32 — the PR 4/10 identity contract)",
+        "prefill_chunk": chunk,
+        "long_prompt_chunks": long_chunks,
+        "decode_streams": n_dec,
+        "decode_budget": dec_budget,
+        "decode_loop_depth": 2,
+        "episodes": episodes,
+        "sweep": sweep,
+        "dispatches_per_round_base": round(base["dpr"], 3),
+        "dispatches_per_round_top": round(top["dpr"], 3),
+        "freerun_engaged": top["window"]["finchat_freerun_dispatches_total"] >= 1,
+        "greedy_outputs_identical": identical,
+        "zero_leaks": not any(r["leaks"] for r in results.values()),
+        "leak_report": sum((r["leaks"] for r in results.values()), []),
+        "warmup_variants": {f: r["warmup_variants"] for f, r in results.items()},
+        "platform": jax.devices()[0].platform,
+        "device": str(jax.devices()[0]),
+    }
+
+
 def measure_chaos_sweep(smoke: bool = False, rates: tuple = (0.05, 0.2)) -> dict:
     """Chaos benchmark of the resilience plane (ISSUE 5), CPU-runnable
     through the REAL scheduler on the tiny fp32 config (fp32 pins greedy
@@ -2725,6 +2936,9 @@ def spawn_worker(args: argparse.Namespace, platform: str, timeout: float) -> dic
     if args.ragged_sweep or args.ragged_smoke:
         cmd += (["--ragged-smoke"] if args.ragged_smoke
                 else ["--ragged-sweep"])
+    if args.freerun_sweep or args.freerun_smoke:
+        cmd += (["--freerun-smoke"] if args.freerun_smoke
+                else ["--freerun-sweep"])
     if args.tool_overlap_sweep or args.tool_overlap_smoke:
         cmd += (["--tool-overlap-smoke"] if args.tool_overlap_smoke
                 else ["--tool-overlap-sweep"])

@@ -69,6 +69,17 @@ SCHEDULER_HOT = {
     "_pack_prefill_rows",
 }
 
+# the freerun-consume check (ISSUE 13): the free-running loop's ring-drain
+# seam joins the hot set by name — a block_until_ready / .item() /
+# implicit __bool__ on the drain path would re-serialize the host against
+# the very capture the loop exists to overlap (the token ring must be
+# fetched through the off-loop to_thread seam, never synced inline)
+FREERUN_HOT = {
+    "_dispatch_freerun",
+    "_consume_ring",
+}
+SCHEDULER_HOT |= FREERUN_HOT
+
 ENGINE_COLD = {"__init__", "create_state", "warmup", "rebuild_device_state"}
 
 _TAINT_ROOTS = {"jnp", "lax"}

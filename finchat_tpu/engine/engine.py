@@ -28,6 +28,12 @@ point has ONE static shape per (batch-bucket) —
   model dispatches to one, with no per-mode demotions (ISSUE 10; PR 4's
   padded ``[rows, chunk]`` buffer demoted on spec/loop/constrained work
   and paid dense decode-row compute per padded column).
+- ``ragged_multi_round``: the free-running loop (ISSUE 13) — up to
+  ``freerun_rounds`` consecutive ragged rounds captured as ONE device
+  program (``lax.scan`` over the same round body), with a staged
+  descriptor queue the rounds drain in order, on-device EOS stop masks
+  generalized to every row, and a per-round output token ring the host
+  drains asynchronously; host control returns only at membership epochs.
 
 State is donated on every call and the KV cache is updated IN PLACE by the
 Pallas append kernel (ops/kv_append.py) on the decode path — XLA's scatter
@@ -570,6 +576,188 @@ def _ragged_attention_fn(
     return attention
 
 
+def _ragged_round_math(
+    params: dict[str, Any],
+    state: DecodeState,
+    tokens: Array,  # [T] int32 PACKED token buffer (0 at device-read positions)
+    tok_row: Array,  # [T] int32 — owning row, ascending contiguous (R = padding)
+    row_slot: Array,  # [R] int32 — engine slot per row
+    row_start: Array,  # [R] int32 — abs pos of the row's first token (prefill)
+    row_len: Array,  # [R] int32 — tokens in the row (0 = padding row)
+    row_from_device: Array,  # [R] bool — token 0 reads last_tokens[slot] and the
+    #   row starts at context_lens[slot] (decode rows, spec verify rows)
+    row_arm: Array,  # [R] bool — commit this row's sampled token to last_tokens
+    row_n_drafts: Array,  # [R] int32 — spec rows: row_len == 1 + n_drafts
+    temperature: Array,  # [R] — PER-ROW sampling params
+    top_p: Array,  # [R]
+    top_k: Array,  # [R] int32
+    loop_active: Array,  # [max_seqs] bool — slots riding the fused K-token tail
+    loop_temperature: Array,  # [max_seqs] — per-SLOT params for the tail
+    loop_top_p: Array,  # [max_seqs]
+    loop_top_k: Array,  # [max_seqs] int32
+    eos_id: Array,  # scalar int32 (< 0 disables the tail's stop mask)
+    row_live: Array,  # [R] bool — free-run stop mask (see docstring)
+    *,
+    config: LlamaConfig,
+    page_size: int,
+    attn_backend: str = "ref",
+    spec_width: int = 0,
+    loop_depth: int = 1,
+) -> tuple[DecodeState, Array, Array, Array, Array]:
+    """The packed ragged round body, shared VERBATIM by the single-round
+    ``ragged_mixed_step`` and the multi-round free-run capture
+    (``ragged_multi_round``) so a captured round is bit-identical math to
+    a host-stepped one by construction.
+
+    ``row_live`` is the free-run generalization of ``decode_loop_step``'s
+    per-slot stop mask to the full ragged row set: a dead row rides the
+    round fully inert — its KV writes trash-redirect (the scatter sees
+    ``n_valid 0``), nothing arms, ``context_lens``/``last_tokens`` stay
+    frozen, and its emitted count is 0 (the host drain sentinel). The
+    single-round path passes all-True, which reduces every gate below to
+    the identity — the mixed-vs-split byte-identity tests pin that the
+    extraction changed nothing. (See ``ragged_mixed_step`` for the full
+    row/descriptor contract.)"""
+    T = tokens.shape[0]
+    R = row_slot.shape[0]
+    B = state.context_lens.shape[0]
+    W = spec_width + 1
+    tok_row = jnp.asarray(tok_row, jnp.int32)
+    safe_row = jnp.minimum(tok_row, R - 1)
+    # dead rows' tokens are demoted to padding: KV writes trash-redirect
+    # and attention treats them as buffer padding (all-True live mask →
+    # exactly the original tok_row < R predicate)
+    tok_valid = (tok_row < R) & row_live[safe_row]
+    # nothing arms on a dead row: n_emitted 0, last_tokens delta 0
+    row_arm = row_arm & row_live
+    q_start = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32),
+         jnp.cumsum(row_len, dtype=jnp.int32)[:-1]]
+    )  # [R] exclusive — rows packed in ascending contiguous order
+    tok_off = jnp.arange(T, dtype=jnp.int32) - q_start[safe_row]
+    eff_start = jnp.where(
+        row_from_device, state.context_lens[row_slot], row_start
+    )  # [R]
+    tok_pos = jnp.where(tok_valid, eff_start[safe_row] + tok_off, 0)
+    row_last = state.last_tokens[row_slot]  # [R]
+    tok_in = jnp.where(
+        tok_valid & row_from_device[safe_row] & (tok_off == 0),
+        row_last[safe_row], tokens,
+    )
+    page_rows = state.page_table[row_slot]  # [R, max_pages]
+    row_kv_len = jnp.where(row_len > 0, eff_start + row_len, 0)  # [R]
+
+    attention = _ragged_attention_fn(
+        page_rows, tok_row, tok_pos, row_kv_len, tok_valid,
+        page_size, config.n_kv_heads, attn_backend,
+    )
+    # hidden states only, then project only each row's sampling positions —
+    # the [T, vocab] fp32 logits tensor would cost GBs at production shapes
+    hidden, (k_pages, v_pages, k_scales, v_scales) = forward(
+        params, tok_in[None], tok_pos[None],
+        config=config, attention=attention,
+        cache=(state.k_pages, state.v_pages, state.k_scales, state.v_scales),
+        return_hidden=True,
+    )
+    h = hidden[0]  # [T, D]
+
+    # sampling positions: spec rows need logits at EVERY row position
+    # (ascending, for draft acceptance); every other row only at its last
+    # valid token — all W columns point there, so column 0 is always the
+    # row's sampling position
+    col = jnp.arange(W, dtype=jnp.int32)[None, :]  # [1, W]
+    last_off = jnp.maximum(row_len - 1, 0)[:, None]  # [R, 1]
+    sel_off = jnp.where(
+        (row_n_drafts > 0)[:, None], jnp.minimum(col, last_off), last_off
+    )
+    sel_idx = jnp.clip(q_start[:, None] + sel_off, 0, T - 1)  # [R, W]
+    logits = lm_head(params, h[sel_idx], config=config)  # [R, W, vocab] fp32
+    preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [R, W]
+
+    # spec acceptance — verify_step's math over the packed drafts: draft
+    # column i (1..W-1) is accepted while every earlier draft matched and
+    # it equals the model's prediction for its position
+    cols_d = jnp.arange(1, W, dtype=jnp.int32)[None, :]  # [1, W-1]
+    draft_tok = tok_in[jnp.clip(q_start[:, None] + cols_d, 0, T - 1)]
+    match = (cols_d <= row_n_drafts[:, None]) & (draft_tok == preds[:, :-1])
+    accepted = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(axis=1)  # [R]
+
+    rng, sub = jax.random.split(state.rng)
+    row_logits = logits[:, 0, :]  # [R, vocab] — each row's sampling position
+    sampled0 = sample(row_logits, sub, temperature, top_p, top_k)  # [R]
+    emitted = jnp.concatenate([sampled0[:, None], preds[:, 1:]], axis=1)
+    n_emitted = jnp.where(
+        row_arm, jnp.where(row_n_drafts > 0, accepted + 1, 1), 0
+    )
+    last_tok = jnp.take_along_axis(emitted, accepted[:, None], axis=1)[:, 0]
+
+    # context advance: spec rows move by what they EMITTED (rejected
+    # drafts' KV stays beyond the new length); every other row by its
+    # packed length (chunk for prefill, 1 for decode, 0 for padding);
+    # dead free-run rows stay frozen
+    advance = jnp.where(
+        row_live, jnp.where(row_n_drafts > 0, n_emitted, row_len), 0
+    )
+    delta = jnp.where(row_arm, last_tok - row_last, 0)
+    state = dataclasses.replace(
+        state,
+        k_pages=k_pages,
+        v_pages=v_pages,
+        k_scales=k_scales,
+        v_scales=v_scales,
+        context_lens=state.context_lens.at[row_slot].add(advance),
+        last_tokens=state.last_tokens.at[row_slot].add(delta),
+        rng=rng,
+    )
+
+    # fused K-token tail: loop-eligible decode slots free-run loop_depth-1
+    # further iterations in the SAME dispatch — the decode_loop_step body
+    # verbatim (same forward, appends, sampling, EOS mask, rng discipline),
+    # so the tail is byte-identical to a split-path block
+    token_block = jnp.full((max(loop_depth - 1, 0), B), -1, jnp.int32)
+    if loop_depth > 1:
+        live0 = loop_active & (state.last_tokens != eos_id)
+
+        def body(i, carry):
+            state, live, token_block = carry
+            toks = state.last_tokens[:, None]  # [B, 1]
+            positions = state.context_lens[:, None]
+            n_valid = live.astype(jnp.int32)
+
+            attn = _paged_attention_fn(
+                state.page_table, state.context_lens, n_valid,
+                page_size, config.n_kv_heads, attn_backend,
+            )
+            step_logits, (kp, vp, ks, vs) = forward(
+                params, toks, positions,
+                config=config, attention=attn,
+                cache=(state.k_pages, state.v_pages,
+                       state.k_scales, state.v_scales),
+            )
+            step_logits = step_logits[:, 0, :]
+            rng, sub = jax.random.split(state.rng)
+            next_tokens = sample(
+                step_logits, sub, loop_temperature, loop_top_p, loop_top_k
+            )
+            state = dataclasses.replace(
+                state,
+                k_pages=kp, v_pages=vp, k_scales=ks, v_scales=vs,
+                context_lens=state.context_lens + n_valid,
+                last_tokens=jnp.where(live, next_tokens, state.last_tokens),
+                rng=rng,
+            )
+            token_block = token_block.at[i].set(
+                jnp.where(live, next_tokens, -1)
+            )
+            live = live & (next_tokens != eos_id)
+            return state, live, token_block
+
+        state, _, token_block = jax.lax.fori_loop(
+            0, loop_depth - 1, body, (state, live0, token_block)
+        )
+    return state, emitted, n_emitted, row_logits, token_block
+
+
 @partial(
     jax.jit,
     static_argnames=("config", "page_size", "attn_backend", "spec_width",
@@ -640,136 +828,115 @@ def ragged_mixed_step(
     shape can differ in the last ulp from the ``[max_seqs, 1]`` shape and
     flip a later near-tie argmax — either stream is a valid greedy decode.
     """
-    T = tokens.shape[0]
     R = row_slot.shape[0]
-    B = state.context_lens.shape[0]
-    W = spec_width + 1
-    tok_row = jnp.asarray(tok_row, jnp.int32)
-    tok_valid = tok_row < R
-    safe_row = jnp.minimum(tok_row, R - 1)
-    q_start = jnp.concatenate(
-        [jnp.zeros((1,), jnp.int32),
-         jnp.cumsum(row_len, dtype=jnp.int32)[:-1]]
-    )  # [R] exclusive — rows packed in ascending contiguous order
-    tok_off = jnp.arange(T, dtype=jnp.int32) - q_start[safe_row]
-    eff_start = jnp.where(
-        row_from_device, state.context_lens[row_slot], row_start
-    )  # [R]
-    tok_pos = jnp.where(tok_valid, eff_start[safe_row] + tok_off, 0)
-    row_last = state.last_tokens[row_slot]  # [R]
-    tok_in = jnp.where(
-        tok_valid & row_from_device[safe_row] & (tok_off == 0),
-        row_last[safe_row], tokens,
-    )
-    page_rows = state.page_table[row_slot]  # [R, max_pages]
-    row_kv_len = jnp.where(row_len > 0, eff_start + row_len, 0)  # [R]
-
-    attention = _ragged_attention_fn(
-        page_rows, tok_row, tok_pos, row_kv_len, tok_valid,
-        page_size, config.n_kv_heads, attn_backend,
-    )
-    # hidden states only, then project only each row's sampling positions —
-    # the [T, vocab] fp32 logits tensor would cost GBs at production shapes
-    hidden, (k_pages, v_pages, k_scales, v_scales) = forward(
-        params, tok_in[None], tok_pos[None],
-        config=config, attention=attention,
-        cache=(state.k_pages, state.v_pages, state.k_scales, state.v_scales),
-        return_hidden=True,
-    )
-    h = hidden[0]  # [T, D]
-
-    # sampling positions: spec rows need logits at EVERY row position
-    # (ascending, for draft acceptance); every other row only at its last
-    # valid token — all W columns point there, so column 0 is always the
-    # row's sampling position
-    col = jnp.arange(W, dtype=jnp.int32)[None, :]  # [1, W]
-    last_off = jnp.maximum(row_len - 1, 0)[:, None]  # [R, 1]
-    sel_off = jnp.where(
-        (row_n_drafts > 0)[:, None], jnp.minimum(col, last_off), last_off
-    )
-    sel_idx = jnp.clip(q_start[:, None] + sel_off, 0, T - 1)  # [R, W]
-    logits = lm_head(params, h[sel_idx], config=config)  # [R, W, vocab] fp32
-    preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [R, W]
-
-    # spec acceptance — verify_step's math over the packed drafts: draft
-    # column i (1..W-1) is accepted while every earlier draft matched and
-    # it equals the model's prediction for its position
-    cols_d = jnp.arange(1, W, dtype=jnp.int32)[None, :]  # [1, W-1]
-    draft_tok = tok_in[jnp.clip(q_start[:, None] + cols_d, 0, T - 1)]
-    match = (cols_d <= row_n_drafts[:, None]) & (draft_tok == preds[:, :-1])
-    accepted = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(axis=1)  # [R]
-
-    rng, sub = jax.random.split(state.rng)
-    row_logits = logits[:, 0, :]  # [R, vocab] — each row's sampling position
-    sampled0 = sample(row_logits, sub, temperature, top_p, top_k)  # [R]
-    emitted = jnp.concatenate([sampled0[:, None], preds[:, 1:]], axis=1)
-    n_emitted = jnp.where(
-        row_arm, jnp.where(row_n_drafts > 0, accepted + 1, 1), 0
-    )
-    last_tok = jnp.take_along_axis(emitted, accepted[:, None], axis=1)[:, 0]
-
-    # context advance: spec rows move by what they EMITTED (rejected
-    # drafts' KV stays beyond the new length); every other row by its
-    # packed length (chunk for prefill, 1 for decode, 0 for padding)
-    advance = jnp.where(row_n_drafts > 0, n_emitted, row_len)
-    delta = jnp.where(row_arm, last_tok - row_last, 0)
-    state = dataclasses.replace(
-        state,
-        k_pages=k_pages,
-        v_pages=v_pages,
-        k_scales=k_scales,
-        v_scales=v_scales,
-        context_lens=state.context_lens.at[row_slot].add(advance),
-        last_tokens=state.last_tokens.at[row_slot].add(delta),
-        rng=rng,
+    return _ragged_round_math(
+        params, state, tokens, tok_row, row_slot, row_start, row_len,
+        row_from_device, row_arm, row_n_drafts, temperature, top_p, top_k,
+        loop_active, loop_temperature, loop_top_p, loop_top_k, eos_id,
+        jnp.ones((R,), bool),  # every row live: the host stepped this round
+        config=config, page_size=page_size, attn_backend=attn_backend,
+        spec_width=spec_width, loop_depth=loop_depth,
     )
 
-    # fused K-token tail: loop-eligible decode slots free-run loop_depth-1
-    # further iterations in the SAME dispatch — the decode_loop_step body
-    # verbatim (same forward, appends, sampling, EOS mask, rng discipline),
-    # so the tail is byte-identical to a split-path block
-    token_block = jnp.full((max(loop_depth - 1, 0), B), -1, jnp.int32)
-    if loop_depth > 1:
-        live0 = loop_active & (state.last_tokens != eos_id)
 
-        def body(i, carry):
-            state, live, token_block = carry
-            toks = state.last_tokens[:, None]  # [B, 1]
-            positions = state.context_lens[:, None]
-            n_valid = live.astype(jnp.int32)
+@partial(
+    jax.jit,
+    static_argnames=("config", "page_size", "attn_backend", "loop_depth"),
+    donate_argnums=(1,),
+)
+def ragged_multi_round(
+    params: dict[str, Any],
+    state: DecodeState,
+    tokens: Array,  # [F, T] int32 — staged packed token buffer PER ROUND
+    tok_row: Array,  # [F, T] int32
+    row_slot: Array,  # [R] int32 — row↔slot binding is FIXED across the run
+    row_start: Array,  # [F, R] int32
+    row_len: Array,  # [F, R] int32
+    row_from_device: Array,  # [F, R] bool
+    row_arm: Array,  # [F, R] bool
+    temperature: Array,  # [R] — per-row sampling params (fixed across rounds)
+    top_p: Array,  # [R]
+    top_k: Array,  # [R] int32
+    loop_active: Array,  # [F, max_seqs] bool — staged fused-tail schedule
+    loop_temperature: Array,  # [max_seqs]
+    loop_top_p: Array,  # [max_seqs]
+    loop_top_k: Array,  # [max_seqs] int32
+    eos_id: Array,  # scalar int32
+    *,
+    config: LlamaConfig,
+    page_size: int,
+    attn_backend: str = "ref",
+    loop_depth: int = 1,
+) -> tuple[DecodeState, Array, Array, Array]:
+    """The free-running serving loop (ISSUE 13): ``F = freerun_rounds``
+    consecutive ragged rounds captured as ONE replayable device program —
+    a ``lax.scan`` over the exact ``_ragged_round_math`` body the
+    host-stepped path runs, erasing F-1 of every F host round-trips.
 
-            attn = _paged_attention_fn(
-                state.page_table, state.context_lens, n_valid,
-                page_size, config.n_kv_heads, attn_backend,
-            )
-            step_logits, (kp, vp, ks, vs) = forward(
-                params, toks, positions,
-                config=config, attention=attn,
-                cache=(state.k_pages, state.v_pages,
-                       state.k_scales, state.v_scales),
-            )
-            step_logits = step_logits[:, 0, :]
-            rng, sub = jax.random.split(state.rng)
-            next_tokens = sample(
-                step_logits, sub, loop_temperature, loop_top_p, loop_top_k
-            )
-            state = dataclasses.replace(
-                state,
-                k_pages=kp, v_pages=vp, k_scales=ks, v_scales=vs,
-                context_lens=state.context_lens + n_valid,
-                last_tokens=jnp.where(live, next_tokens, state.last_tokens),
-                rng=rng,
-            )
-            token_block = token_block.at[i].set(
-                jnp.where(live, next_tokens, -1)
-            )
-            live = live & (next_tokens != eos_id)
-            return state, live, token_block
+    - **Staged-descriptor queue**: the leading ``[F, ...]`` axis of the
+      descriptor arrays is a queue in device memory that rounds drain in
+      order. The host pre-stages each round at dispatch time from data it
+      already owns — prompt chunks advance deterministically, so a
+      prefill row's completion round is known ahead and later rounds
+      stage it as an on-device-sampled decode row (on-device admission of
+      the pre-staged prompt: the completing round arms the row and its
+      first token commits to ``last_tokens`` with no host involvement,
+      exactly ``commit_first_token``'s math).
+    - **On-device stop masks**: budget exhaustion is staged away by the
+      host (a row past its remaining ``max_new_tokens`` simply stops
+      appearing in later rounds' descriptors); EOS — the one
+      data-dependent stop — is the device's: a round recomputes
+      ``row_live`` from ``last_tokens[row_slot] == eos_id`` for
+      device-read rows, so a row that commits EOS (in its own round OR
+      its fused tail) rides every later round inert, emitting 0. This is
+      ``decode_loop_step``'s per-slot mask generalized to the ragged row
+      set, and it is also what makes a stale capture safe: rows whose
+      stream the host has since retired stay dead because their EOS is
+      still in ``last_tokens`` until the post-run slot reset applies.
+    - **Output ring**: per-round emissions land in the scan's stacked
+      output buffers — ``ring_tokens [F, R]`` (each armed row's token),
+      ``ring_n [F, R]`` (0 = mid-prompt chunk / dead row — the drain
+      sentinel), ``ring_blocks [F, loop_depth-1, max_seqs]`` (the fused
+      tails). The scheduler drains the ring off-loop while the device is
+      mid-flight on the NEXT capture (depth-2, engine/scheduler.py
+      ``_consume_ring``).
 
-        state, _, token_block = jax.lax.fori_loop(
-            0, loop_depth - 1, body, (state, live0, token_block)
+    No spec verify rows inside a capture (drafts are host data proposed
+    from DELIVERED tokens; live proposal windows cap the capture to one
+    round — scheduler ``_freerun_rounds_cap``), so ``spec_width`` is
+    pinned to 0 and each ring round emits at most one token per row plus
+    its tail. Returns ``(state, ring_tokens, ring_n, ring_blocks)``.
+
+    Byte-identity contract: round r of a capture is bit-identical math to
+    the r'th host-stepped ``ragged_mixed_step`` over the same descriptors
+    (same body, same rng split discipline — tests/test_freerun.py and
+    bench --freerun-sweep pin the stream-level identity at fp32)."""
+    R = row_slot.shape[0]
+    no_drafts = jnp.zeros((R,), jnp.int32)
+
+    def one_round(state, staged):
+        toks, trow, rstart, rlen, rdev, rarm, lact = staged
+        # the EOS stop mask: device-read rows whose slot already committed
+        # EOS ride this round dead (eos_id < 0 disables, as in the tail)
+        row_live = jnp.logical_not(
+            rdev & (state.last_tokens[row_slot] == eos_id)
         )
-    return state, emitted, n_emitted, row_logits, token_block
+        state, emitted, n_emitted, _row_logits, blk = _ragged_round_math(
+            params, state, toks, trow, row_slot, rstart, rlen, rdev, rarm,
+            no_drafts, temperature, top_p, top_k, lact,
+            loop_temperature, loop_top_p, loop_top_k, eos_id, row_live,
+            config=config, page_size=page_size, attn_backend=attn_backend,
+            spec_width=0, loop_depth=loop_depth,
+        )
+        # W = 1 (no spec rows): column 0 is every armed row's token
+        return state, (emitted[:, 0], n_emitted, blk)
+
+    state, (ring_tokens, ring_n, ring_blocks) = jax.lax.scan(
+        one_round, state,
+        (tokens, tok_row, row_start, row_len, row_from_device, row_arm,
+         loop_active),
+    )
+    return state, ring_tokens, ring_n, ring_blocks
 
 
 @partial(
@@ -999,6 +1166,9 @@ class InferenceEngine:
         # fused multi-step decode (decode_loop_step): tokens per dispatch;
         # 1 = per-token decode_step only (today's behavior)
         self.decode_loop_depth = max(1, engine_cfg.decode_loop_depth)
+        # free-running loop (ragged_multi_round): consecutive ragged
+        # rounds captured per dispatch; 1 = host-stepped rounds only
+        self.freerun_rounds = max(1, engine_cfg.freerun_rounds)
         # serving-variant count of the last warmup() (0 = not warmed yet);
         # the scheduler emits it as the finchat_warmup_compiled_variants
         # gauge — the ISSUE 10 warmup-matrix-collapse instrument
@@ -1367,6 +1537,29 @@ class InferenceEngine:
                     loop_depth=self.decode_loop_depth,
                 )
                 n_variants += 1
+            if self.freerun_rounds > 1:
+                # the captured multi-round program (ragged_multi_round) —
+                # one extra variant per packed-token bucket at the fixed
+                # freerun_rounds depth, all-padding rounds keeping it
+                # state-neutral exactly like the single-round warmup
+                F = self.freerun_rounds
+                for t in self.ragged_token_buckets():
+                    self.state, _, _, _ = ragged_multi_round(
+                        self.params, self.state,
+                        jnp.zeros((F, t), jnp.int32),
+                        jnp.full((F, t), R, jnp.int32),
+                        rz, jnp.zeros((F, R), jnp.int32),
+                        jnp.zeros((F, R), jnp.int32),
+                        jnp.zeros((F, R), bool), jnp.zeros((F, R), bool),
+                        jnp.zeros((R,), jnp.float32),
+                        jnp.ones((R,), jnp.float32),
+                        jnp.zeros((R,), jnp.int32),
+                        jnp.zeros((F, B), bool), bz, bo, bk, jnp.int32(-1),
+                        config=self.config, page_size=self.page_size,
+                        attn_backend=self.attn_backend,
+                        loop_depth=self.decode_loop_depth,
+                    )
+                    n_variants += 1
         inactive = jnp.zeros((B,), bool)
         temp = jnp.full((B,), 1.0, jnp.float32)
         top_p = jnp.ones((B,), jnp.float32)
@@ -1536,6 +1729,32 @@ class InferenceEngine:
             )
         )
         return emitted, n_emitted, row_logits, loop_block
+
+    def ragged_multi(self, tokens, tok_row, row_slot, row_start, row_len,  # finchat-lint: hot
+                     row_from_device, row_arm, temperature, top_p, top_k,
+                     loop_active, loop_temperature, loop_top_p, loop_top_k,
+                     eos_id: int):
+        """One captured multi-round dispatch (see ragged_multi_round):
+        ``tokens.shape[0]`` consecutive ragged rounds in ONE enqueued
+        device program, returning the per-round token ring
+        ``(ring_tokens, ring_n, ring_blocks)`` as device arrays — the
+        scheduler drains them off-loop while the device free-runs the
+        next capture. Counted ONCE at the dispatch seam (one program),
+        exactly why bench --freerun-sweep's dispatches-per-round figure
+        drops below 1."""
+        from finchat_tpu.utils.metrics import METRICS
+
+        METRICS.inc("finchat_mixed_dispatches_total")
+        self.state, ring_tokens, ring_n, ring_blocks = ragged_multi_round(
+            self.params, self.state, tokens, tok_row, row_slot, row_start,
+            row_len, row_from_device, row_arm, temperature, top_p, top_k,
+            loop_active, loop_temperature, loop_top_p, loop_top_k,
+            jnp.int32(eos_id),
+            config=self.config, page_size=self.page_size,
+            attn_backend=self.attn_backend,
+            loop_depth=self.decode_loop_depth,
+        )
+        return ring_tokens, ring_n, ring_blocks
 
     def decode_loop(self, active, temperature, top_p, top_k, eos_id: int,
                     depth: int | None = None):

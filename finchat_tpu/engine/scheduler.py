@@ -47,6 +47,22 @@ model replica:
   golden-identical fallback (greedy streams are byte-identical either
   way; tests/test_mixed_step.py pins it); demotions are counted per
   reason in ``finchat_mixed_demotions_total``.
+- Free-running device loop (``engine.freerun_rounds`` > 1; ISSUE 13):
+  when the mixed path is live and no row needs a per-round host decision
+  (no grammar-constrained rows, no live spec-proposal window — the
+  ``_use_mixed``-style cap), up to ``freerun_rounds`` consecutive ragged
+  rounds are CAPTURED into one device program
+  (engine.ragged_multi_round): prefill descriptors for every round are
+  pre-staged into a device-memory queue the rounds drain (completed
+  prompts flip to on-device-sampled decode rows mid-run), EOS stops via
+  the on-device ``row_live`` mask (budget stops are staged away), and
+  per-round tokens land in an output ring the host drains OFF-LOOP while
+  the device free-runs the next capture (depth-2). Host control returns
+  only at membership epochs: any admit/evict/preempt/breaker event ends
+  re-entry at a round boundary, residual ring tokens replay exactly once
+  under the PR 5 epoch discipline, and the host-stepped round (and split
+  path below it) remain the golden-identical fallbacks. Dispatches per
+  ROUND drop to 1/freerun_rounds on the coexist counters.
 - Session KV cache (engine/session_cache.py): sequences submitted with a
   ``conversation_id`` snapshot their KV pages device→host when they retire
   normally (eos/length, before the pages are freed) and the conversation's
@@ -237,6 +253,31 @@ class _InFlightBlock:
 
 
 @dataclass
+class _InFlightRing:
+    """A dispatched-but-unconsumed captured multi-round run (the
+    free-running loop, ISSUE 13): the per-round token ring device arrays
+    from ``engine.ragged_multi`` plus the staged plan's host bookkeeping.
+    Members carry the admission epoch exactly like ``_InFlightStep`` —
+    the PR 5 discipline is what makes an epoch boundary (admit / evict /
+    preempt / breaker while the capture is mid-flight) safe: stale rows'
+    ring tokens are discarded at drain time and the preempt-replay
+    recomputes them, so delivery stays exactly-once."""
+
+    tokens: object  # [F, R] int32, device — each armed row's round token
+    n_emitted: object  # [F, R] int32, device (0 = mid-prompt chunk / dead)
+    blocks: object  # [F, K-1, max_seqs] int32, device — fused tails
+    rounds: int
+    # (row, slot, owner, epoch, kind) — owner is a SequenceHandle for
+    # "prefill"/"decode" rows, a _PrefixJob for "job" rows (no tokens)
+    members: list
+    armed: object  # np [F, R] staged arm mask — exactly-once replay ref
+    loop_rounds: object  # np [F, max_seqs] staged fused-tail schedule
+    completes_at: dict  # row -> round its prompt completes (first token)
+    ahead: dict  # slot -> staged max emissions (budget accounting for
+    #   the NEXT dispatch staged before this ring is consumed)
+
+
+@dataclass
 class _PrefixJob:
     """An in-progress chunked prefix registration (register_prefix_async):
     the head prefills one chunk per prefill round, riding the same batched
@@ -347,6 +388,23 @@ class ContinuousBatchingScheduler:
         # headline) is exact, not a racy window over global counters
         self._dispatch_tally = 0
         self._coexist_mark: int | None = None
+        # free-running loop (ISSUE 13): consecutive ragged rounds captured
+        # per dispatch (engine.freerun_rounds; 1 = host-stepped rounds).
+        # _round_tally counts logical serving ROUNDS the same way
+        # _dispatch_tally counts enqueued programs — a captured run books
+        # F rounds for its one dispatch — and the same mark/attribute pair
+        # lands both in the coexist counters, so the headline ratio
+        # becomes dispatches per ROUND (< 1 once captures engage) measured
+        # by the exact PR 10 attribution, not a new ad-hoc window.
+        self.freerun_rounds = max(1, getattr(engine, "freerun_rounds", 1))
+        self._round_tally = 0
+        self._coexist_round_mark = 0
+        if self.freerun_rounds > 1:
+            # pre-seed the cap reasons (the _use_mixed demotion-counter
+            # discipline): a capture that never caps is visible as zeros
+            for reason in self.FREERUN_CAP_REASONS:
+                self.metrics.inc("finchat_freerun_capped_total", 0.0,
+                                 labels={"reason": reason})
         # trace-event track label (utils/tracing.py — ISSUE 12): one
         # Perfetto track per engine so a fleet's dispatch timelines stay
         # separable in one export
@@ -1855,6 +1913,9 @@ class ContinuousBatchingScheduler:
         same round."""
         eng = self.engine
         C = eng.engine_cfg.prefill_chunk
+        # one logical serving round (the dispatches-per-ROUND denominator;
+        # the decode dispatch riding the same iteration is the same round)
+        self._round_tally += 1
         batch: list[SequenceHandle] = []
         # (handle, device logits row, epoch) triples whose prompt completed
         # this round — the epoch tells a preempted-and-replayed incarnation
@@ -2044,6 +2105,253 @@ class ContinuousBatchingScheduler:
     # ISSUE 10 point) spec / decode_loop / constrained never fire again
     MIXED_DEMOTION_REASONS = ("spec", "decode_loop", "constrained", "ring", "other")
 
+    # every reason a free-run capture caps to one host-stepped round —
+    # pre-seeded at 0 when the free-running loop is enabled (the same
+    # discipline as MIXED_DEMOTION_REASONS)
+    FREERUN_CAP_REASONS = ("constrained", "spec", "underfill")
+
+    def _freerun_rounds_cap(self) -> int:
+        """How many consecutive rounds the next capture may free-run — the
+        ``_use_mixed``-style predicate of ISSUE 13. Rows that need a HOST
+        decision every round cap the capture to 1 (exactly today's
+        host-stepped behavior): grammar-constrained rows (the host pick
+        feeds the next round's input) and live spec-proposal windows
+        (drafts are proposed from DELIVERED tokens the device is still
+        holding). Ring-routed rows already demote the whole mixed path
+        (``_use_mixed``), so they never reach here."""
+        F = self.freerun_rounds
+        if F <= 1:
+            return 1
+        if (any(h.constraint is not None for h in self.decoding.values())
+                or any(h.constraint is not None for h in self.prefilling
+                       if not self._parked(h))):
+            # parked holds are skipped by the staging anyway (and today's
+            # overlap API never parks a constrained prompt) — only rows
+            # that would actually ride the capture may cap it
+            self.metrics.inc("finchat_freerun_capped_total",
+                             labels={"reason": "constrained"})
+            return 1
+        if self.spec_k > 0 and self._spec_cooldown == 0 and self._spec_candidates():
+            self.metrics.inc("finchat_freerun_capped_total",
+                             labels={"reason": "spec"})
+            return 1
+        return F
+
+    def _dispatch_freerun(self, rounds: int,  # finchat-lint: hot
+                          ahead: dict[int, int]) -> "_InFlightRing | None":
+        """Stage and enqueue ONE captured multi-round program (ISSUE 13;
+        engine.ragged_multi over ops/freerun.stage_freerun): every
+        prefilling row's next ``rounds`` chunks, every decode slot's next
+        ``rounds`` tokens (with fused tails where eligible), and the
+        completion→decode flips in between are pre-staged into the
+        descriptor queue; the device then free-runs ``rounds`` ragged
+        rounds with no host round-trip, emitting into the token ring this
+        returns. Returns None — the caller runs the host-stepped single
+        round instead — when the staged plan cannot fill every round
+        (work runs out mid-capture; empty device rounds would be pure
+        waste). ``ahead`` is ``_undelivered()`` for the still-unconsumed
+        in-flight dispatch: budgets are staged NET of it, so a capture
+        staged before the previous ring drains can never run a stream
+        past ``max_new_tokens`` or its page allocation."""
+        from finchat_tpu.ops.freerun import RowSpec, stage_freerun
+
+        eng = self.engine
+        C = eng.engine_cfg.prefill_chunk
+        B = eng.engine_cfg.max_seqs
+        specs: list[RowSpec] = []
+        members: list[tuple] = []
+
+        def _budget(h: SequenceHandle) -> int:
+            return max(
+                0, h.sampling.max_new_tokens - h.generated - ahead.get(h.slot, 0)
+            )
+
+        for handle in list(self.prefilling):
+            if self._parked(handle):
+                continue  # awaiting extend_prompt
+            try:
+                inject("scheduler.prefill", seq_id=handle.seq_id,
+                       replica=self.replica_id)
+            except Exception as e:  # per-sequence isolation, as in _ragged_round
+                logger.error("prefill error for %s: %s", handle.seq_id, e)
+                self._evict(handle, "error", error=str(e))
+                continue
+            s = handle.sampling
+            if handle.prefill_pos >= len(handle.prompt_ids):
+                # completed inside a still-unconsumed ring: its first
+                # token is in flight (counted in ``ahead``) and this
+                # capture stages it as a plain decode row
+                specs.append(RowSpec(
+                    slot=handle.slot, kind="decode", budget=_budget(handle),
+                    loop_ok=self.loop_depth > 1,
+                    temperature=s.temperature, top_p=s.top_p, top_k=s.top_k,
+                ))
+                members.append((len(specs) - 1, handle.slot, handle,
+                                handle.epoch, "decode"))
+                continue
+            specs.append(RowSpec(
+                slot=handle.slot, kind="prefill", ids=handle.prompt_ids,
+                pos=handle.prefill_pos, arm=not handle.held,
+                budget=_budget(handle), loop_ok=self.loop_depth > 1,
+                temperature=s.temperature, top_p=s.top_p, top_k=s.top_k,
+            ))
+            members.append((len(specs) - 1, handle.slot, handle,
+                            handle.epoch, "prefill"))
+        jobs = list(self._prefix_jobs)
+        for job in jobs:
+            specs.append(RowSpec(slot=job.slot, kind="job",
+                                 ids=job.ids[: job.shared_len], pos=job.pos,
+                                 arm=False))
+            members.append((len(specs) - 1, job.slot, job, 0, "job"))
+        for slot, handle in self.decoding.items():
+            s = handle.sampling
+            specs.append(RowSpec(
+                slot=slot, kind="decode", budget=_budget(handle),
+                loop_ok=self.loop_depth > 1,
+                temperature=s.temperature, top_p=s.top_p, top_k=s.top_k,
+            ))
+            members.append((len(specs) - 1, slot, handle, handle.epoch,
+                            "decode"))
+        if not specs:
+            return None  # a fault drained everything; split paths resume
+
+        plan = stage_freerun(specs, rounds=rounds, chunk=C,
+                             loop_depth=self.loop_depth, max_seqs=B,
+                             bucket=eng.ragged_bucket)
+        if plan.active_rounds < rounds:
+            # the work runs out before the capture would: fall back to the
+            # host-stepped round rather than free-running empty rounds
+            self.metrics.inc("finchat_freerun_capped_total",
+                             labels={"reason": "underfill"})
+            return None
+        inject("scheduler.decode", replica=self.replica_id)
+        inject("scheduler.mixed", replica=self.replica_id)
+        with Timer(self.metrics, "finchat_mixed_step_seconds") as _mt:
+            ring_tok, ring_n, ring_blk = eng.ragged_multi(
+                jnp.asarray(plan.tokens), jnp.asarray(plan.tok_row),
+                jnp.asarray(plan.row_slot), jnp.asarray(plan.row_start),
+                jnp.asarray(plan.row_len), jnp.asarray(plan.row_from_device),
+                jnp.asarray(plan.row_arm),
+                jnp.asarray(plan.temperature), jnp.asarray(plan.top_p),
+                jnp.asarray(plan.top_k), jnp.asarray(plan.loop_active),
+                jnp.asarray(self._temperature), jnp.asarray(self._top_p),
+                jnp.asarray(self._top_k), self.eos_id,
+            )
+        self._dispatch_tally += 1
+        self._round_tally += rounds
+        self.metrics.inc("finchat_freerun_dispatches_total")
+        # unit is ROUNDS, not seconds: the N-rounds-per-1-dispatch
+        # attribution instrument ISSUE 13 names
+        self.metrics.observe("finchat_freerun_rounds_per_dispatch", rounds)  # finchat-lint: disable=metrics-discipline -- rounds-per-dispatch histogram: the unit is rounds (ISSUE 13 names this metric); _seconds would be a lie
+        if TRACER.enabled:
+            trows = []
+            for _row, slot, owner, _epoch, kind in members:
+                tid = (f"prefix:{owner.owner}" if kind == "job"
+                       else (owner.trace_id or owner.seq_id))
+                trows.append([slot, tid, "freerun"])
+            self._trace_dispatch("freerun", trows,
+                                 ts=_mt.started, dur=_mt.elapsed)
+        # prompt-cursor bookkeeping at dispatch, exactly _ragged_round's
+        # discipline: the staged chunks ARE dispatched
+        for row, _slot, owner, _epoch, kind in members:
+            adv = plan.advanced.get(row, 0)
+            if not adv:
+                continue
+            if kind == "prefill":
+                owner.prefill_pos += adv
+            elif kind == "job":
+                owner.pos += adv
+                if owner.pos >= owner.shared_len:
+                    self._complete_prefix_job(owner, "freerun")
+        return _InFlightRing(
+            tokens=ring_tok, n_emitted=ring_n, blocks=ring_blk,
+            rounds=rounds, members=members, armed=plan.row_arm,
+            loop_rounds=plan.loop_active, completes_at=plan.completes_at,
+            ahead=plan.ahead,
+        )
+
+    async def _consume_ring(self, ring: _InFlightRing) -> None:  # finchat-lint: hot
+        """Drain a captured run's token ring: ONE device→host fetch (in a
+        worker thread — never ``block_until_ready`` on the consume path,
+        the finchat-lint R2 seam) for up to ``rounds`` tokens per row plus
+        the fused tails, delivered round-by-round in device order. Runs
+        while the device is already mid-flight on the NEXT capture
+        (depth-2). Stale rows — evicted / preempted / replayed since
+        dispatch, detected by the (slot, handle, epoch) snapshot — have
+        their residual ring tokens discarded exactly once and recomputed
+        by the replay (the PR 5 discipline); such a drain is the epoch
+        boundary and is recorded as a ``freerun_epoch_break`` trace
+        event. A round emitting where the staged plan never armed is a
+        free-run divergence: flight-recorder dump, tokens not
+        delivered."""
+        tok_host, n_host, blk_host = await asyncio.to_thread(
+            lambda: (np.asarray(ring.tokens), np.asarray(ring.n_emitted),
+                     np.asarray(ring.blocks)),
+        )
+        armed = ring.armed
+        if bool(((n_host > 0) & ~armed).any()):
+            # ring replay mismatch: the device emitted outside the staged
+            # schedule — dump the black box and deliver nothing from the
+            # unarmed cells (they were never part of any stream)
+            self.metrics.inc("finchat_freerun_divergences_total")
+            TRACER.anomaly("freerun_divergence", args={
+                "replica": self.replica_id, "rounds": ring.rounds,
+                "cells": int(((n_host > 0) & ~armed).sum()),
+            })
+        K1 = int(blk_host.shape[1])
+        wasted = 0
+        epoch_break = False
+        for r in range(ring.rounds):
+            for row, slot, owner, epoch, kind in ring.members:
+                if kind == "job":
+                    continue
+                handle: SequenceHandle = owner
+                stale = (handle.finished or handle.slot != slot
+                         or handle.epoch != epoch)
+                n = int(n_host[r, row])
+                if n > 0 and armed[r, row]:
+                    if stale:
+                        # evicted/cancelled/preempted since dispatch: the
+                        # replay recomputes this token — discarding it
+                        # here is what keeps delivery exactly-once
+                        epoch_break = True
+                        wasted += n
+                    else:
+                        if ring.completes_at.get(row) == r:
+                            handle.span.mark("prefill_done")
+                            self.prefilling.remove(handle)
+                            self.decoding[handle.slot] = handle
+                        self._deliver(handle, int(tok_host[r, row]))
+                        stale = (handle.finished or handle.slot != slot
+                                 or handle.epoch != epoch)
+                if K1 and ring.loop_rounds[r, slot]:
+                    # fused tail rows: -1 marks where the device stop
+                    # mask kicked in (exactly _consume_block's drain)
+                    if stale:
+                        wasted += K1
+                        continue
+                    for j in range(K1):
+                        token = int(blk_host[r, j, slot])
+                        if token < 0:
+                            wasted += K1 - j
+                            break
+                        self._deliver(handle, token)
+                        if handle.finished:
+                            wasted += K1 - j - 1
+                            break
+        if wasted:
+            self.metrics.inc("finchat_decode_loop_wasted_tail_tokens_total",
+                             wasted)
+        if epoch_break:
+            # the membership epoch invalidated this capture mid-flight:
+            # visible on the Perfetto timeline as the capture/replay
+            # boundary (ISSUE 13)
+            self.metrics.inc("finchat_freerun_epoch_breaks_total")
+            TRACER.event("freerun_epoch_break", track=self._trace_track,
+                         args={"replica": self.replica_id,
+                               "rounds": ring.rounds})
+        self.metrics.set_gauge("finchat_batch_occupancy", len(self.decoding))
+
     def _use_mixed(self) -> bool:
         """Can this iteration run ONE packed ragged dispatch instead of a
         prefill round plus a decode-side dispatch? Both populations must
@@ -2084,6 +2392,7 @@ class ContinuousBatchingScheduler:
         eng = self.engine
         C = eng.engine_cfg.prefill_chunk
         B = eng.engine_cfg.max_seqs
+        self._round_tally += 1  # one host-stepped serving round
         Kd = self.spec_k
         spec_on = Kd > 0 and self._spec_cooldown == 0
         batch: list[SequenceHandle] = []
@@ -2444,6 +2753,10 @@ class ContinuousBatchingScheduler:
         allocation."""
         if inflight is None:
             return {}
+        if isinstance(inflight, _InFlightRing):
+            # the staged plan's max emissions per slot (budget already
+            # consumed deterministically at staging time)
+            return dict(inflight.ahead)
         if isinstance(inflight, _InFlightBlock):
             ahead = {slot: self.loop_depth for slot, _h, _e in inflight.block_members}
             if inflight.step is not None:
@@ -2726,13 +3039,18 @@ class ContinuousBatchingScheduler:
     def _pending_constrained(self, inflight) -> set[int]:
         """Constrained slots whose host-side pick lands only when
         ``inflight`` is consumed — they must sit out the next dispatch.
-        In a block, constrained slots only ever ride the demoted step."""
+        In a block, constrained slots only ever ride the demoted step; a
+        free-run capture never carries constrained rows (the cap)."""
+        if isinstance(inflight, _InFlightRing):
+            return set()
         if isinstance(inflight, _InFlightBlock):
             return set(inflight.step.constrained_slots) if inflight.step else set()
         return set(inflight.constrained_slots)
 
     async def _consume_inflight(self, inflight) -> None:
-        if isinstance(inflight, _InFlightBlock):
+        if isinstance(inflight, _InFlightRing):
+            await self._consume_ring(inflight)
+        elif isinstance(inflight, _InFlightBlock):
             await self._consume_block(inflight)
         else:
             await self._consume_step(inflight)
@@ -2748,9 +3066,14 @@ class ContinuousBatchingScheduler:
         try:
             await self._consume_inflight(inflight)
             self._note_round_ok("decode")
+            if isinstance(inflight, _InFlightRing):
+                # a captured run carried the prefill rows too: its drain
+                # is a successful round of BOTH planes
+                self._note_round_ok("prefill")
         except Exception as e:
             logger.error("in-flight step consume error: %s", e)
-            await self._round_failed("decode", str(e))
+            scope = "mixed" if isinstance(inflight, _InFlightRing) else "decode"
+            await self._round_failed(scope, str(e))
         return None
 
     async def _loop(self) -> None:
@@ -2764,6 +3087,11 @@ class ContinuousBatchingScheduler:
             if self._coexist_mark is not None:
                 self.metrics.inc("finchat_coexist_dispatches_total",
                                  self._dispatch_tally - self._coexist_mark)
+                # ...and the logical ROUNDS those dispatches advanced (a
+                # captured free-run books F rounds for its 1 dispatch) —
+                # together the exact dispatches-per-round ratio (ISSUE 13)
+                self.metrics.inc("finchat_coexist_rounds_total",
+                                 self._round_tally - self._coexist_round_mark)
                 self._coexist_mark = None
             # parked holds (prefix prefilled, waiting for extend_prompt)
             # are not work: without the _prefill_work() refinement the
@@ -2822,6 +3150,7 @@ class ContinuousBatchingScheduler:
             if prefill_active and self.decoding:
                 self.metrics.inc("finchat_coexist_iterations_total")
                 self._coexist_mark = self._dispatch_tally
+                self._coexist_round_mark = self._round_tally
 
             if self._spec_cooldown > 0:
                 # demoted after sustained all-miss steps: count pipelined
@@ -2829,9 +3158,39 @@ class ContinuousBatchingScheduler:
                 self._spec_cooldown -= 1
 
             if self._use_mixed():
-                # the mixed path is depth-1 (dispatch + consume within the
-                # iteration — the prefill side was synchronous in the split
-                # path too): drain any pipelined split-path leftover first
+                rounds = self._freerun_rounds_cap()
+                if rounds > 1:
+                    # free-running loop (ISSUE 13), depth-2: stage and
+                    # dispatch the next captured multi-round program FIRST,
+                    # then drain the previous in-flight dispatch's tokens —
+                    # the host delivers to streams while the device is
+                    # mid-flight on the later rounds. Membership events in
+                    # between (admit/evict/preempt/breaker) end re-entry at
+                    # this round boundary: the next iteration re-stages
+                    # from the new snapshot, and stale residual ring
+                    # tokens replay exactly once via the epoch discipline.
+                    ring = None
+                    try:
+                        ring = self._dispatch_freerun(
+                            rounds, self._undelivered(inflight))
+                    except Exception as e:
+                        logger.error("freerun dispatch error: %s", e)
+                        if inflight is not None:
+                            inflight = await self._drain_inflight(inflight)
+                        await self._round_failed("mixed", str(e))
+                        await asyncio.sleep(0)
+                        continue
+                    if ring is not None:
+                        prev, inflight = inflight, ring
+                        if prev is not None:
+                            await self._drain_inflight(prev)
+                        await asyncio.sleep(0)  # let producers/consumers run
+                        continue
+                    # staging underfilled: fall through to the host-stepped
+                    # single round below
+                # the host-stepped mixed path is depth-1 (dispatch + consume
+                # within the iteration — the prefill side was synchronous in
+                # the split path too): drain any pipelined leftover first
                 if inflight is not None:
                     inflight = await self._drain_inflight(inflight)
                 if self._use_mixed():  # consuming may have evicted slots
@@ -2848,6 +3207,20 @@ class ContinuousBatchingScheduler:
                         await self._round_failed("mixed", str(e))
                     await asyncio.sleep(0)  # let producers/consumers run
                     continue
+
+            if isinstance(inflight, _InFlightRing):
+                # leaving the mixed path with a captured run still in
+                # flight (the decode side was cancelled/evicted, or a
+                # ring-routed admission demoted the iteration): the ring
+                # must drain BEFORE any split-path round. A prompt that
+                # completed INSIDE the capture is still in `prefilling`
+                # until the drain flips it to decoding — a split prefill
+                # round running first would re-complete it on an empty
+                # chunk (a garbage duplicate first token off an all-padding
+                # logits row, then the drain's flip raises). Regression:
+                # tests/test_freerun.py
+                # test_freerun_cancel_mid_capture_spares_completions.
+                inflight = await self._drain_inflight(inflight)
 
             # one batched prefill round (all prefilling sequences advance a
             # chunk together), interleaved with decode so TTFT work cannot

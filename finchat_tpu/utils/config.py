@@ -287,6 +287,20 @@ class EngineConfig:
     # spec decode, decode_loop blocks, grammar-constrained picks, or
     # ring/seq-sharded prefill are active.
     mixed_step: bool = True
+    # free-running device loop (ISSUE 13; engine ragged_multi_round): up
+    # to this many CONSECUTIVE ragged rounds are captured into ONE device
+    # dispatch — the staged-descriptor queue pre-admits each round's
+    # prefill chunks, completed prompts flip to on-device-sampled decode
+    # rows mid-run, the decode_loop EOS/budget stop mask generalizes to
+    # every row, and per-round tokens land in an output ring the host
+    # drains asynchronously while the device is mid-flight on the NEXT
+    # capture. Host control returns only at membership epochs (admission,
+    # eviction, preemption, breaker — the PR 5 epoch discipline), and
+    # grammar-constrained or live spec-proposal rows cap the capture to 1
+    # round (today's behavior). 1 = off (one host round-trip per round).
+    # Streams stay byte-identical to the round-stepped path (fp32
+    # contract; bench --freerun-sweep gates it). Requires mixed_step.
+    freerun_rounds: int = 1
     # persistent XLA compilation cache directory
     # (jax_compilation_cache_dir): warmup's compiles land on disk and a
     # restarted process reloads them instead of re-paying full XLA
@@ -622,6 +636,9 @@ def load_config(
         "FINCHAT_TOOL_STREAMING", cfg.engine.tool_streaming
     )
     cfg.engine.mixed_step = _env_bool("FINCHAT_MIXED_STEP", cfg.engine.mixed_step)
+    cfg.engine.freerun_rounds = _env_int(
+        "FINCHAT_FREERUN_ROUNDS", cfg.engine.freerun_rounds
+    )
     cfg.engine.compilation_cache_dir = _env(
         "FINCHAT_COMPILATION_CACHE_DIR", cfg.engine.compilation_cache_dir
     )

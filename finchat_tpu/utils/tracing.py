@@ -80,12 +80,20 @@ TRACE_EVENTS = frozenset({
     "drain_handoff",    # breaker drain handed a stream to a sibling
     "session_migrate",  # session-cache bytes moved between replicas
     "request",          # whole-request complete span (emitted at finish)
+    # a membership epoch invalidated an in-flight free-run capture: the
+    # drain discarded stale residual ring tokens (replayed exactly once
+    # via preempt/replay) — the capture/replay boundary on the timeline
+    "freerun_epoch_break",
 })
 
 #: Anomaly kinds — each records an event AND triggers a flight dump.
 ANOMALY_KINDS = frozenset({
     "breaker_trip", "watchdog_timeout", "shed", "replica_give_up",
     "record_quarantine", "sigterm_drain",
+    # free-run ring replay mismatch: a captured round emitted where the
+    # staged descriptor plan never armed a row (ISSUE 13) — the drain
+    # refuses the unarmed cells and dumps the black box
+    "freerun_divergence",
 })
 
 TRACE_EVENT_NAMES = SPAN_MARKS | TRACE_EVENTS | ANOMALY_KINDS

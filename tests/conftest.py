@@ -62,6 +62,7 @@ SANITIZED_MODULES = {
     "test_resilience",
     "test_session_cache",
     "test_mixed_step",
+    "test_freerun",
     "test_faults",
     "test_decode_loop",
     "test_prefix_cache",

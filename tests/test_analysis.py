@@ -249,6 +249,51 @@ def test_r2_host_helpers_do_not_taint(tmp_path):
     assert res.findings == []
 
 
+def test_r2_freerun_consume_check(tmp_path):
+    """The freerun-consume extension (ISSUE 13): the free-running loop's
+    ring-drain functions join the hot set BY NAME in engine/scheduler.py —
+    a ``block_until_ready``, ``.item()``, D2H, or implicit ``__bool__`` on
+    the ring re-serializes the host against the very capture the loop
+    exists to overlap. The blessed off-loop ``to_thread`` fetch stays
+    clean."""
+    bad = """
+        import jax.numpy as jnp
+        import numpy as np
+
+        class Sched:
+            async def _consume_ring(self, ring):
+                ring_tok = jnp.ones((4, 4))
+                ring_tok.block_until_ready()
+                n = np.asarray(ring_tok)
+                if ring_tok:
+                    pass
+                return n
+    """
+    res = _lint(tmp_path, {"engine/scheduler.py": bad}, {"hot-path-host-sync"})
+    msgs = " | ".join(_messages(res))
+    assert "block_until_ready" in msgs
+    assert "D2H" in msgs
+    assert "__bool__" in msgs
+    assert len(res.findings) == 3
+    good = """
+        import asyncio
+        import jax.numpy as jnp
+        import numpy as np
+
+        class Sched:
+            async def _consume_ring(self, ring):
+                ring_tok = jnp.ones((4, 4))
+                host = await asyncio.to_thread(lambda: np.asarray(ring_tok))
+                return host
+
+            async def _dispatch_freerun(self, rounds):
+                ring = jnp.ones((4, 4))
+                return ring
+    """
+    res = _lint(tmp_path, {"engine/scheduler.py": good}, {"hot-path-host-sync"})
+    assert res.findings == []
+
+
 def test_r2_cold_functions_not_hot(tmp_path):
     src = """
         import numpy as np

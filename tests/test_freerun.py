@@ -1,0 +1,620 @@
+"""Free-running device loop (ISSUE 13; engine ragged_multi_round +
+scheduler _dispatch_freerun/_consume_ring over ops/freerun.stage_freerun).
+
+The contract under test: a captured multi-round run is pure dispatch
+fusion — greedy streams are byte-identical to the host-stepped path
+(freerun_rounds=1) under every riding feature (fused loop tails, mid-run
+EOS via the on-device stop mask, prompts completing and flipping to decode
+rows mid-capture, admissions mid-flight forcing an epoch break), residual
+ring tokens replay exactly once across a preemption epoch boundary (no
+duplicate or dropped tokens — the PR 5 discipline), the dispatch counters
+attribute a capture as N rounds / 1 dispatch so dispatches-per-round drops
+below 1, rows needing host decisions (grammar constraints, live spec
+proposals) cap the capture to one round, and allocator/slot state audits
+leak-free after free-run waves (the conftest sanitizer also audits every
+scheduler built here)."""
+
+import asyncio
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from finchat_tpu.engine.engine import (
+    InferenceEngine,
+    commit_first_token,
+    prefill_step,
+    ragged_mixed_step,
+    ragged_multi_round,
+)
+from finchat_tpu.engine.kv_cache import PageAllocator, pages_needed
+from finchat_tpu.engine.sampler import SamplingParams
+from finchat_tpu.engine.scheduler import ContinuousBatchingScheduler
+from finchat_tpu.models.tokenizer import ByteTokenizer
+from finchat_tpu.models.llama import PRESETS, init_params
+from finchat_tpu.utils.config import EngineConfig
+from finchat_tpu.utils.metrics import METRICS
+from finchat_tpu.utils.tracing import TRACER
+
+# fp32 pins the byte-identity contract (the PR 4/10 discipline): a token
+# computed inside a captured scan must match the host-stepped round bit
+# for bit, so a structural staging bug cannot hide behind bf16 rounding
+CONFIG = dataclasses.replace(PRESETS["tiny"], dtype=jnp.float32)
+CHUNK = 16
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CONFIG, jax.random.key(0))
+
+
+def _stack(params, freerun=4, max_seqs=4, num_pages=128, eos_id=-1,
+           decode_loop_depth=1, spec_tokens=0):
+    cfg = EngineConfig(
+        max_seqs=max_seqs, page_size=8, num_pages=num_pages, max_seq_len=128,
+        prefill_chunk=CHUNK, mixed_step=True, session_cache=False,
+        decode_loop_depth=decode_loop_depth, spec_tokens=spec_tokens,
+        freerun_rounds=freerun,
+    )
+    engine = InferenceEngine(CONFIG, params, cfg)
+    return ContinuousBatchingScheduler(engine, eos_id=eos_id)
+
+
+async def _drain(handle, out):
+    while True:
+        ev = await asyncio.wait_for(handle.events.get(), timeout=120)
+        if ev["type"] == "token":
+            out.append(ev["token_id"])
+        elif ev["type"] == "done":
+            assert handle.events.empty()
+            return
+        else:
+            raise AssertionError(ev)
+
+
+# --- engine level -----------------------------------------------------------
+
+
+def test_engine_multi_round_matches_stepped_rounds(params):
+    """ragged_multi_round over a staged 3-round queue == 3 host-stepped
+    ragged_mixed_step calls over the same descriptors, exactly: the
+    completing prefill row's on-device first token, the decode rows'
+    tokens, the fused tails, and the final context_lens/last_tokens all
+    match an identically prepared engine — the captured round body IS the
+    host-stepped one."""
+
+    def prepare():
+        cfg = EngineConfig(
+            max_seqs=4, page_size=8, num_pages=64, max_seq_len=128,
+            prefill_chunk=CHUNK, decode_loop_depth=2, freerun_rounds=3,
+        )
+        eng = InferenceEngine(CONFIG, params, cfg)
+        alloc = PageAllocator(cfg.num_pages)
+        # slot 0: decoding (rides a fused tail each round)
+        p0 = [3, 7, 11, 200, 42]
+        eng.set_page_table_row(0, alloc.allocate("s0", pages_needed(len(p0) + 16, 8)))
+        logits = eng.prefill(0, p0)
+        eng.state, _ = commit_first_token(
+            eng.state, jnp.int32(0), logits,
+            jnp.float32(0.0), jnp.float32(1.0), jnp.int32(0),
+        )
+        # slot 1: a 2-chunk prompt with only the FIRST chunk prefilled —
+        # its tail completes in round 0 of the capture, decodes after
+        p1 = list(range(1, CHUNK + 6))
+        eng.set_page_table_row(1, alloc.allocate("s1", pages_needed(len(p1) + 16, 8)))
+        eng.state, _ = prefill_step(
+            eng.params, eng.state,
+            jnp.asarray([p1[:CHUNK]], jnp.int32), jnp.asarray([1], jnp.int32),
+            jnp.asarray([0], jnp.int32), jnp.asarray([CHUNK], jnp.int32),
+            config=eng.config, page_size=8, attn_backend=eng.attn_backend,
+        )
+        return eng, p1
+
+    B, R, F = 4, 4, 3
+    tail = None
+    zR = np.zeros((R,), np.float32)
+    oR = np.ones((R,), np.float32)
+    kR = np.zeros((R,), np.int32)
+    zB = jnp.zeros((B,), jnp.float32)
+    oB = jnp.ones((B,), jnp.float32)
+    kB = jnp.zeros((B,), jnp.int32)
+
+    def stage():
+        """The 3-round descriptor queue: round 0 = slot 1's completing
+        tail (armed) + slot 0 decode w/ tail; rounds 1-2 = both slots
+        decode, slot 0 with tails."""
+        eng, p1 = prepare()
+        tail = p1[CHUNK:]
+        T = 8
+        tokens = np.zeros((F, T), np.int32)
+        tok_row = np.full((F, T), R, np.int32)
+        row_slot = np.zeros((R,), np.int32)
+        row_slot[0] = 1  # row 0 = slot 1 (prefill), row 1 = slot 0
+        row_slot[1] = 0
+        row_start = np.zeros((F, R), np.int32)
+        row_len = np.zeros((F, R), np.int32)
+        from_dev = np.zeros((F, R), bool)
+        arm = np.zeros((F, R), bool)
+        loop_active = np.zeros((F, B), bool)
+        # round 0
+        tokens[0, : len(tail)] = tail
+        tok_row[0, : len(tail)] = 0
+        tok_row[0, len(tail)] = 1
+        row_start[0, 0], row_len[0, 0], arm[0, 0] = CHUNK, len(tail), True
+        row_len[0, 1], from_dev[0, 1], arm[0, 1] = 1, True, True
+        loop_active[0, 0] = True
+        # rounds 1-2: both decode; slot 0 keeps its tail
+        for r in (1, 2):
+            tok_row[r, 0] = 0
+            tok_row[r, 1] = 1
+            row_len[r, 0], from_dev[r, 0], arm[r, 0] = 1, True, True
+            row_len[r, 1], from_dev[r, 1], arm[r, 1] = 1, True, True
+            loop_active[r, 0] = True
+        return eng, (tokens, tok_row, row_slot, row_start, row_len,
+                     from_dev, arm, loop_active)
+
+    # --- host-stepped: 3 ragged_mixed_step calls ------------------------
+    eng_s, staged = stage()
+    (tokens, tok_row, row_slot, row_start, row_len, from_dev, arm,
+     loop_active) = staged
+    stepped = []
+    for r in range(F):
+        eng_s.state, emitted, n_em, _lg, blk = ragged_mixed_step(
+            eng_s.params, eng_s.state,
+            jnp.asarray(tokens[r]), jnp.asarray(tok_row[r]),
+            jnp.asarray(row_slot), jnp.asarray(row_start[r]),
+            jnp.asarray(row_len[r]), jnp.asarray(from_dev[r]),
+            jnp.asarray(arm[r]), jnp.zeros((R,), jnp.int32),
+            jnp.asarray(zR), jnp.asarray(oR), jnp.asarray(kR),
+            jnp.asarray(loop_active[r]), zB, oB, kB, jnp.int32(-1),
+            config=eng_s.config, page_size=8, attn_backend=eng_s.attn_backend,
+            spec_width=0, loop_depth=2,
+        )
+        stepped.append((np.asarray(emitted[:, 0]).tolist(),
+                        np.asarray(n_em).tolist(),
+                        np.asarray(blk).tolist()))
+    final_s = (np.asarray(eng_s.state.context_lens).tolist(),
+               np.asarray(eng_s.state.last_tokens).tolist())
+
+    # --- captured: ONE ragged_multi_round dispatch ----------------------
+    eng_c, staged = stage()
+    (tokens, tok_row, row_slot, row_start, row_len, from_dev, arm,
+     loop_active) = staged
+    eng_c.state, ring_tok, ring_n, ring_blk = ragged_multi_round(
+        eng_c.params, eng_c.state,
+        jnp.asarray(tokens), jnp.asarray(tok_row), jnp.asarray(row_slot),
+        jnp.asarray(row_start), jnp.asarray(row_len), jnp.asarray(from_dev),
+        jnp.asarray(arm),
+        jnp.asarray(zR), jnp.asarray(oR), jnp.asarray(kR),
+        jnp.asarray(loop_active), zB, oB, kB, jnp.int32(-1),
+        config=eng_c.config, page_size=8, attn_backend=eng_c.attn_backend,
+        loop_depth=2,
+    )
+    captured = [
+        (np.asarray(ring_tok[r]).tolist(), np.asarray(ring_n[r]).tolist(),
+         np.asarray(ring_blk[r]).tolist())
+        for r in range(F)
+    ]
+    final_c = (np.asarray(eng_c.state.context_lens).tolist(),
+               np.asarray(eng_c.state.last_tokens).tolist())
+    assert captured == stepped
+    assert final_c == final_s
+
+
+def test_engine_multi_round_eos_stop_mask(params):
+    """The generalized stop mask: a decode row whose slot holds EOS in
+    last_tokens rides every captured round inert — n_emitted 0, context
+    frozen, KV writes trash-redirected — while the other row advances."""
+    cfg = EngineConfig(
+        max_seqs=2, page_size=8, num_pages=32, max_seq_len=64,
+        prefill_chunk=8, freerun_rounds=3,
+    )
+    eng = InferenceEngine(CONFIG, params, cfg)
+    alloc = PageAllocator(cfg.num_pages)
+    for slot, p in ((0, [3, 7, 11, 200, 42]), (1, [9, 9, 9, 9])):
+        eng.set_page_table_row(
+            slot, alloc.allocate(f"s{slot}", pages_needed(len(p) + 16, 8)))
+        logits = eng.prefill(slot, p)
+        eng.state, _ = commit_first_token(
+            eng.state, jnp.int32(slot), logits,
+            jnp.float32(0.0), jnp.float32(1.0), jnp.int32(0),
+        )
+    # pretend slot 1's last commit WAS the eos token
+    eos = 77
+    eng.set_last_token(1, eos)
+    ctx_before = np.asarray(eng.state.context_lens).tolist()
+    F, R, B, T = 3, 2, 2, 8
+    tokens = np.zeros((F, T), np.int32)
+    tok_row = np.full((F, T), R, np.int32)
+    tok_row[:, 0] = 0
+    tok_row[:, 1] = 1
+    ones = np.ones((F, R), np.int32)
+    true_ = np.ones((F, R), bool)
+    eng.state, ring_tok, ring_n, _blk = ragged_multi_round(
+        eng.params, eng.state,
+        jnp.asarray(tokens), jnp.asarray(tok_row),
+        jnp.asarray([0, 1], jnp.int32), jnp.zeros((F, R), jnp.int32),
+        jnp.asarray(ones), jnp.asarray(true_), jnp.asarray(true_),
+        jnp.zeros((R,), jnp.float32), jnp.ones((R,), jnp.float32),
+        jnp.zeros((R,), jnp.int32),
+        jnp.zeros((F, B), bool), jnp.zeros((B,), jnp.float32),
+        jnp.ones((B,), jnp.float32), jnp.zeros((B,), jnp.int32),
+        jnp.int32(eos),
+        config=eng.config, page_size=8, attn_backend=eng.attn_backend,
+        loop_depth=1,
+    )
+    n = np.asarray(ring_n)
+    assert n[:, 0].tolist() == [1, 1, 1]  # live row advanced every round
+    assert n[:, 1].tolist() == [0, 0, 0]  # dead row inert every round
+    ctx = np.asarray(eng.state.context_lens).tolist()
+    assert ctx[0] == ctx_before[0] + 3
+    assert ctx[1] == ctx_before[1]  # frozen
+    assert int(eng.state.last_tokens[1]) == eos  # still the sentinel
+
+
+# --- scheduler level --------------------------------------------------------
+
+
+def _run_workload(params, freerun, *, eos_id=-1, decode_loop_depth=2,
+                  spec_tokens=0, seed=7, constrained=False):
+    """Two decode streams, then a long prompt admitted mid-decode (so its
+    chunks coexist with live decodes and the captures carry prefill +
+    completion-flip + decode rows). Returns (streams, freerun dispatches,
+    coexist dispatches/rounds window)."""
+    sched = _stack(params, freerun=freerun, eos_id=eos_id,
+                   decode_loop_depth=decode_loop_depth,
+                   spec_tokens=spec_tokens)
+    rng = np.random.default_rng(seed)
+    short_a = rng.integers(1, CONFIG.vocab_size, size=10).tolist()
+    short_b = rng.integers(1, CONFIG.vocab_size, size=14).tolist()
+    long_p = rng.integers(1, CONFIG.vocab_size, size=5 * CHUNK + 2).tolist()
+
+    async def go():
+        snap0 = METRICS.snapshot()
+        await sched.start()
+        try:
+            ha = await sched.submit(
+                "a", short_a, SamplingParams(temperature=0.0, max_new_tokens=28))
+            hb = await sched.submit(
+                "b", short_b, SamplingParams(temperature=0.0, max_new_tokens=22))
+            outs = {"a": [], "b": [], "long": []}
+            tasks = [asyncio.create_task(_drain(ha, outs["a"])),
+                     asyncio.create_task(_drain(hb, outs["b"]))]
+            if constrained:
+                from finchat_tpu.agent.constrained import GrammarVocab, TokenConstraint
+
+                tok = ByteTokenizer()
+                hc = await sched.submit(
+                    "tool", tok.encode("decide", add_bos=True),
+                    SamplingParams(temperature=0.0, max_new_tokens=20),
+                    constraint=TokenConstraint(GrammarVocab.for_tokenizer(tok)),
+                )
+                outs["tool"] = []
+                tasks.append(asyncio.create_task(_drain(hc, outs["tool"])))
+            while len(outs["a"]) < 2 or len(outs["b"]) < 2:
+                await asyncio.sleep(0.002)
+            hl = await sched.submit(
+                "long", long_p, SamplingParams(temperature=0.0, max_new_tokens=8))
+            tasks.append(asyncio.create_task(_drain(hl, outs["long"])))
+            await asyncio.gather(*tasks)
+            await asyncio.sleep(0.05)  # post-episode tick: attribution lands
+            sched.allocator.check_invariants()
+            assert sched.allocator.used_count == 0
+            assert sorted(sched.free_slots) == list(range(4))
+            snap1 = METRICS.snapshot()
+            win = {
+                k: snap1.get(k, 0) - snap0.get(k, 0)
+                for k in ("finchat_freerun_dispatches_total",
+                          "finchat_coexist_dispatches_total",
+                          "finchat_coexist_rounds_total",
+                          "finchat_coexist_iterations_total")
+            }
+            return outs, win
+        finally:
+            await sched.stop()
+
+    return asyncio.run(go())
+
+
+@pytest.mark.parametrize("seed", [7, 11, 23])
+def test_freerun_streams_byte_identical(params, seed):
+    """3-seed feature fuzz: loop tails + admission mid-flight (the long
+    prompt lands while captures are in flight, forcing the epoch-boundary
+    re-entry) — every greedy stream byte-identical captured vs
+    host-stepped, with captures actually engaging."""
+    base, _ = _run_workload(params, 1, seed=seed)
+    fr, win = _run_workload(params, 4, seed=seed)
+    assert win["finchat_freerun_dispatches_total"] >= 1
+    assert fr == base
+
+
+def test_freerun_mid_run_eos_byte_identical(params):
+    """Mid-run EOS: pick a token the base run emits mid-stream, make it
+    the eos id, and re-run both modes — the device stop mask must end the
+    stream at the same point the host-stepped path does, byte-identically,
+    with the remaining streams unaffected."""
+    base, _ = _run_workload(params, 1)
+    stream = base["a"]
+    eos = stream[len(stream) // 2]  # a token emitted mid-stream
+    base_eos, _ = _run_workload(params, 1, eos_id=eos)
+    fr_eos, win = _run_workload(params, 4, eos_id=eos)
+    # the eos stream genuinely ended early, mid-capture
+    assert len(base_eos["a"]) < len(base["a"])
+    assert win["finchat_freerun_dispatches_total"] >= 1
+    assert fr_eos == base_eos
+
+
+def test_freerun_dispatches_per_round_below_one(params):
+    """The acceptance headline: on a loaded engine (prefill + decode
+    coexisting) at freerun_rounds=4, the PR 10 scheduler-attributed
+    counters must show dispatches per ROUND < 1 — a capture books N
+    rounds for its one dispatch."""
+    _, win = _run_workload(params, 4)
+    assert win["finchat_freerun_dispatches_total"] >= 1
+    rounds = win["finchat_coexist_rounds_total"]
+    dispatches = win["finchat_coexist_dispatches_total"]
+    assert rounds > 0
+    assert dispatches / rounds < 1.0, (dispatches, rounds)
+    # the host-stepped path books exactly 1 dispatch per round
+    _, win1 = _run_workload(params, 1)
+    r1, d1 = win1["finchat_coexist_rounds_total"], win1["finchat_coexist_dispatches_total"]
+    assert r1 > 0 and d1 / r1 >= 1.0, (d1, r1)
+
+
+def test_freerun_capped_for_constrained_rows(params):
+    """Grammar-constrained rows need a host pick every round: with one in
+    the mix the capture must cap to 1 (zero freerun dispatches), streams
+    still correct (byte-identical to freerun off)."""
+    base, _ = _run_workload(params, 1, constrained=True)
+    fr, win = _run_workload(params, 4, constrained=True)
+    assert win["finchat_freerun_dispatches_total"] == 0
+    assert METRICS.get("finchat_freerun_capped_total",
+                       labels={"reason": "constrained"}) >= 1
+    assert fr == base
+
+
+def test_freerun_capped_for_live_spec_proposals(params):
+    """A live spec-proposal window (drafts come from delivered host
+    tokens) caps the capture; streams stay byte-identical to the
+    host-stepped path with the same spec config."""
+    base, _ = _run_workload(params, 1, spec_tokens=2, seed=3)
+    fr, win = _run_workload(params, 4, spec_tokens=2, seed=3)
+    assert fr == base
+
+
+def test_freerun_epoch_boundary_exactly_once(params):
+    """Preempt a decoding stream while a capture is mid-flight: residual
+    ring tokens for the stale epoch are discarded, the replay re-prefills
+    from the handle's history, and the stream completes byte-identical to
+    an unpreempted run — zero duplicate or dropped tokens (the PR 5
+    discipline riding the ring), with the epoch break recorded."""
+    base, _ = _run_workload(params, 1)
+
+    sched = _stack(params, freerun=4, decode_loop_depth=2)
+    rng = np.random.default_rng(7)
+    short_a = rng.integers(1, CONFIG.vocab_size, size=10).tolist()
+    short_b = rng.integers(1, CONFIG.vocab_size, size=14).tolist()
+    long_p = rng.integers(1, CONFIG.vocab_size, size=5 * CHUNK + 2).tolist()
+
+    async def go():
+        d0 = METRICS.get("finchat_freerun_dispatches_total")
+        p0 = METRICS.get("finchat_preemptions_total")
+        await sched.start()
+        try:
+            ha = await sched.submit(
+                "a", short_a, SamplingParams(temperature=0.0, max_new_tokens=28))
+            hb = await sched.submit(
+                "b", short_b, SamplingParams(temperature=0.0, max_new_tokens=22))
+            outs = {"a": [], "b": [], "long": []}
+            tasks = [asyncio.create_task(_drain(ha, outs["a"])),
+                     asyncio.create_task(_drain(hb, outs["b"]))]
+            while len(outs["a"]) < 2 or len(outs["b"]) < 2:
+                await asyncio.sleep(0.002)
+            hl = await sched.submit(
+                "long", long_p, SamplingParams(temperature=0.0, max_new_tokens=8))
+            tasks.append(asyncio.create_task(_drain(hl, outs["long"])))
+            # wait until captures are flying, then preempt stream "a"
+            # mid-flight — its undelivered ring tokens go stale
+            for _ in range(200_000):
+                if METRICS.get("finchat_freerun_dispatches_total") - d0 >= 1:
+                    break
+                await asyncio.sleep(0.001)
+            if not ha.finished:
+                sched._preempt(ha)
+            await asyncio.gather(*tasks)
+            assert METRICS.get("finchat_preemptions_total") - p0 >= 1
+            sched.allocator.check_invariants()
+            assert sched.allocator.used_count == 0
+            return outs
+        finally:
+            await sched.stop()
+
+    outs = asyncio.run(go())
+    assert outs == base  # exactly-once: no dup/dropped tokens anywhere
+
+
+def test_freerun_cancel_mid_capture_spares_completions(params):
+    """Regression (review find): cancelling the only decode stream while
+    a capture is mid-flight empties `decoding`, so the next iteration
+    leaves the mixed path with the ring UNDRAINED — and a prompt that
+    completed inside that capture is still in `prefilling` until the
+    drain flips it. The split prefill round must not run first: it would
+    re-complete the prompt on an empty chunk (a garbage duplicate first
+    token off an all-padding logits row) and the later drain's flip would
+    raise. The loop now drains a leftover ring before any split-path
+    round; the long stream must stay byte-identical to the no-cancel
+    host-stepped run at every cancel timing."""
+    sched_base = _stack(params, freerun=1, decode_loop_depth=1)
+    rng = np.random.default_rng(7)
+    short_a = rng.integers(1, CONFIG.vocab_size, size=10).tolist()
+    long_p = rng.integers(1, CONFIG.vocab_size, size=3 * CHUNK + 2).tolist()
+
+    def run(sched, cancel_after=None):
+        async def go():
+            d0 = METRICS.get("finchat_freerun_dispatches_total")
+            await sched.start()
+            errs: list = []
+
+            async def drain_ok(h, out):
+                while True:
+                    ev = await asyncio.wait_for(h.events.get(), timeout=120)
+                    if ev["type"] == "token":
+                        out.append(ev["token_id"])
+                    elif ev["type"] == "done":
+                        return
+                    else:
+                        errs.append(ev)
+                        return
+
+            try:
+                ha = await sched.submit(
+                    "a", short_a,
+                    SamplingParams(temperature=0.0, max_new_tokens=40))
+                outs = {"a": [], "long": []}
+                ta = asyncio.create_task(drain_ok(ha, outs["a"]))
+                while len(outs["a"]) < 2:
+                    await asyncio.sleep(0.002)
+                hl = await sched.submit(
+                    "long", long_p,
+                    SamplingParams(temperature=0.0, max_new_tokens=6))
+                tl = asyncio.create_task(drain_ok(hl, outs["long"]))
+                if cancel_after is not None:
+                    for _ in range(200_000):
+                        if (METRICS.get("finchat_freerun_dispatches_total")
+                                - d0 >= cancel_after):
+                            break
+                        await asyncio.sleep(0.0005)
+                    sched.cancel(ha)  # mid-flight: the capture goes stale
+                await asyncio.gather(ta, tl)
+                sched.allocator.check_invariants()
+                assert sched.allocator.used_count == 0
+                return outs, errs
+            finally:
+                await sched.stop()
+
+        return asyncio.run(go())
+
+    base, berrs = run(sched_base)
+    assert not berrs
+    for trigger in (1, 2):  # cancel right after the 1st / 2nd capture
+        sched = _stack(params, freerun=4, decode_loop_depth=1)
+        outs, errs = run(sched, cancel_after=trigger)
+        assert not errs, errs
+        assert outs["long"] == base["long"], (trigger, outs["long"])
+
+
+def test_freerun_divergence_anomaly_detected(params):
+    """A ring round emitting where the staged plan never armed a row is a
+    free-run divergence: the drain refuses the cell (nothing delivered)
+    and records the anomaly."""
+    from finchat_tpu.engine.scheduler import _InFlightRing
+
+    sched = _stack(params, freerun=2)
+    F, R, B = 2, 4, 4
+    armed = np.zeros((F, R), bool)  # nothing staged to emit...
+    ring = _InFlightRing(
+        tokens=np.full((F, R), 5, np.int32),
+        n_emitted=np.ones((F, R), np.int32),  # ...yet everything "emitted"
+        blocks=np.full((F, 0, B), -1, np.int32),
+        rounds=F, members=[], armed=armed,
+        loop_rounds=np.zeros((F, B), bool), completes_at={}, ahead={},
+    )
+    d0 = METRICS.get("finchat_freerun_divergences_total")
+    asyncio.run(sched._consume_ring(ring))
+    assert METRICS.get("finchat_freerun_divergences_total") - d0 == 1
+
+
+def test_freerun_dispatch_traced_with_rows(params):
+    """Free-run dispatches land in the trace ring as mode-"freerun" rows
+    (the _trace_dispatch format), so shared-dispatch attribution keeps
+    working on captured rounds."""
+    TRACER.configure(enabled=True)
+    TRACER.clear()
+    _run_workload(params, 4)
+    dispatches = [
+        ev for ev in TRACER.snapshot()
+        if ev[2] == "dispatch" and ev[5] and ev[5].get("kind") == "freerun"
+    ]
+    assert dispatches, "no freerun dispatch event recorded"
+    rows = dispatches[0][5]["rows"]
+    assert rows and all(r[2] == "freerun" for r in rows)
+
+
+def test_freerun_waves_leak_free(params):
+    """Back-to-back admission waves across captures: allocator and slot
+    invariants hold after every wave (the conftest sanitizer additionally
+    audits the stopped scheduler)."""
+    sched = _stack(params, freerun=4, decode_loop_depth=2)
+    rng = np.random.default_rng(5)
+
+    async def go():
+        await sched.start()
+        try:
+            for wave in range(3):
+                handles = []
+                outs = []
+                for i in range(3):
+                    n = int(rng.integers(6, 2 * CHUNK + 4))
+                    p = rng.integers(1, CONFIG.vocab_size, size=n).tolist()
+                    h = await sched.submit(
+                        f"w{wave}-{i}", p,
+                        SamplingParams(temperature=0.0,
+                                       max_new_tokens=int(rng.integers(4, 16))))
+                    handles.append(h)
+                    outs.append([])
+                await asyncio.gather(*[
+                    _drain(h, o) for h, o in zip(handles, outs)
+                ])
+                assert all(o for o in outs)
+                sched.allocator.check_invariants()
+            assert sched.allocator.used_count == 0
+            assert sorted(sched.free_slots) == list(range(4))
+        finally:
+            await sched.stop()
+
+    asyncio.run(go())
+
+
+def test_stage_freerun_underfill_and_budget():
+    """ops/freerun staging: budgets are consumed deterministically (loop
+    rounds take loop_depth, plain rounds 1), exhausted rows stop being
+    staged, and a plan whose work runs out mid-capture reports the
+    underfill so the scheduler falls back to host-stepped rounds."""
+    from finchat_tpu.ops.freerun import RowSpec, stage_freerun
+
+    bucket = lambda n: max(8, n)
+    # a decode row with budget 3 at loop_depth 2: round 0 rides a tail
+    # (consumes 2), round 1 plain (1), rounds 2-3 unstaged -> underfill
+    plan = stage_freerun(
+        [RowSpec(slot=0, kind="decode", budget=3, loop_ok=True)],
+        rounds=4, chunk=4, loop_depth=2, max_seqs=2, bucket=bucket,
+    )
+    assert plan.active_rounds == 2
+    assert plan.loop_active[:, 0].tolist() == [True, False, False, False]
+    assert plan.row_arm[:, 0].tolist() == [True, True, False, False]
+    assert plan.ahead == {0: 3}
+    # a prefill row completes at round 1 (5 tokens, chunk 4), arms there,
+    # then decodes; held rows never arm
+    plan = stage_freerun(
+        [RowSpec(slot=0, kind="prefill", ids=list(range(1, 6)), budget=8,
+                 loop_ok=False),
+         RowSpec(slot=1, kind="prefill", ids=list(range(1, 10)), arm=False)],
+        rounds=3, chunk=4, loop_depth=1, max_seqs=2, bucket=bucket,
+    )
+    assert plan.completes_at == {0: 1}
+    assert plan.row_arm[:, 0].tolist() == [False, True, True]
+    assert plan.row_from_device[:, 0].tolist() == [False, False, True]
+    assert not plan.row_arm[:, 1].any()  # held: parks at prefix end
+    assert plan.advanced == {0: 5, 1: 9}
+    assert plan.active_rounds == 3
+
+
+def test_freerun_config_env_reader(monkeypatch):
+    from finchat_tpu.utils.config import load_config
+
+    monkeypatch.setenv("FINCHAT_FREERUN_ROUNDS", "8")
+    assert load_config().engine.freerun_rounds == 8
+    monkeypatch.delenv("FINCHAT_FREERUN_ROUNDS")
+    assert load_config().engine.freerun_rounds == 1  # host-stepped default

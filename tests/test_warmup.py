@@ -168,6 +168,51 @@ def test_warmup_covers_ragged_step_variants():
     assert np.asarray(eng2.state.page_table).sum() == 0
 
 
+def test_warmup_covers_freerun_capture_variants():
+    """With freerun_rounds > 1 the captured multi-round program
+    (ragged_multi_round) is warmed for every packed-token bucket — the
+    first free-run capture on the serving path must not compile (one
+    extra bucket axis at the fixed rounds depth, ISSUE 13)."""
+    from finchat_tpu.engine.engine import ragged_multi_round
+
+    config = PRESETS["tiny"]
+    engine_cfg = EngineConfig(
+        max_seqs=2, page_size=8, num_pages=32, max_seq_len=64,
+        prefill_chunk=8, decode_loop_depth=2, freerun_rounds=3,
+    )
+    params = init_params(config, jax.random.key(0))
+    eng = InferenceEngine(config, params, engine_cfg, attn_backend="ref")
+    eng.warmup()
+    before = ragged_multi_round._cache_size()
+    assert before > 0, "warmup compiled no freerun variants"
+
+    B = R = 2
+    F = 3
+    zB = jnp.zeros((B,), jnp.float32)
+    for t in eng.ragged_token_buckets():
+        # a serving-shaped capture: one decode row riding a fused tail
+        # every round — reuses the all-padding warmup variant
+        tok_row = np.full((F, t), R, np.int32)
+        tok_row[:, 0] = 0
+        ones = np.ones((F, R), np.int32)
+        ones[:, 1] = 0
+        live = np.zeros((F, R), bool)
+        live[:, 0] = True
+        loop = np.zeros((F, B), bool)
+        loop[:, 0] = True
+        eng.ragged_multi(
+            jnp.zeros((F, t), jnp.int32), jnp.asarray(tok_row),
+            jnp.asarray([0, 1], jnp.int32), jnp.zeros((F, R), jnp.int32),
+            jnp.asarray(ones), jnp.asarray(live), jnp.asarray(live),
+            jnp.zeros((R,), jnp.float32), jnp.ones((R,), jnp.float32),
+            jnp.zeros((R,), jnp.int32),
+            jnp.asarray(loop), zB, jnp.ones((B,), jnp.float32),
+            jnp.zeros((B,), jnp.int32), -1,
+        )
+    assert ragged_multi_round._cache_size() == before, (
+        "first freerun capture recompiled")
+
+
 def test_ragged_bucket_matrix_collapsed():
     """The compiled-variant accounting the warmup gauge reports: the
     ragged bucket list is ONE pow-2 axis whose length never exceeds the
