@@ -62,9 +62,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--attn", choices=("pallas", "ref", "pallas-interpret"),
                    default=None, help="attention backend (default: resolve "
                    "FINCHAT_ATTN / platform in the worker)")
-    p.add_argument("--quant", choices=("int8",), default=None,
-                   help="serve int8 weight-only quantized params "
+    p.add_argument("--quant", choices=("int8", "int4"), default=None,
+                   help="serve int8/int4 weight-only quantized params "
                         "(models/quant.py); default bf16")
+    p.add_argument("--quant-group", type=int, default=None,
+                   help="int4 scale group size along K (0 = per-channel)")
     p.add_argument("--kv-quant", choices=("int8",), default=None,
                    help="int8 paged-KV cache (per-token-per-head scales); "
                         "default: model dtype")
@@ -195,6 +197,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--durability-smoke", action="store_true",
                    help="CI variant of --durability-sweep (same drill, "
                         "smoke-sized)")
+    p.add_argument("--quant-sweep", action="store_true",
+                   help="CPU-runnable benchmark of the quantized serving "
+                        "plane (ISSUE 14): bf16 vs int8-w vs int8-w+int8-KV "
+                        "vs int4-w through the REAL scheduler — decode "
+                        "tok/s, TTFT, page-pool capacity per HBM byte "
+                        "(~2x at int8-KV), prefill-logit quality envelope "
+                        "per mode, session offload->restore byte-identity "
+                        "including the int8 scale planes, resumed-vs-cold "
+                        "greedy identity (exact at fp32 scales), and "
+                        "dispatches/round < 1 with freerun + int8-KV "
+                        "composed; zero-leak audit")
+    p.add_argument("--quant-smoke", action="store_true",
+                   help="tiny --quant-sweep variant for CI: same gates, "
+                        "fewer tokens")
     p.add_argument("--trace-overhead", action="store_true",
                    help="tracing-plane gate (ISSUE 12): traced vs untraced "
                         "decode throughput (< 2%% overhead), a schema-valid "
@@ -257,6 +273,8 @@ def run_worker(args: argparse.Namespace) -> int:
     work = resolve_workload(args, "tpu" if platform == "tpu" else "cpu")
     if args.trace_overhead:
         result = measure_trace_overhead()
+    elif args.quant_sweep or args.quant_smoke:
+        result = measure_quant_sweep(smoke=args.quant_smoke)
     elif args.durability_sweep or args.durability_smoke:
         result = measure_durability_sweep(smoke=args.durability_smoke)
     elif args.fleet_sweep or args.fleet_smoke:
@@ -289,14 +307,17 @@ def run_worker(args: argparse.Namespace) -> int:
             work["page_size"] = 32
         result = measure_session_sweep(
             attn=args.attn, quant=args.quant or "",
+            quant_group=args.quant_group or 0,
             kv_quant=args.kv_quant or "", turns=args.session_turns, **work)
     elif args.decode_loop_sweep:
         depths = tuple(int(d) for d in args.decode_loop_depths.split(","))
         result = measure_decode_loop_sweep(
             attn=args.attn, quant=args.quant or "",
+            quant_group=args.quant_group or 0,
             kv_quant=args.kv_quant or "", depths=depths, **work)
     else:
         result = measure(attn=args.attn, quant=args.quant or "",
+                         quant_group=args.quant_group or 0,
                          kv_quant=args.kv_quant or "",
                          spec_tokens=args.spec_tokens or 0, **work)
     result["backend_init_s"] = round(init_s, 1)
@@ -319,7 +340,8 @@ def run_worker(args: argparse.Namespace) -> int:
 
 def measure(preset: str, batch: int, prompt_len: int, steps: int, warmup: int,
             page_size: int, max_seq_len: int, attn: str | None,
-            quant: str = "", kv_quant: str = "", spec_tokens: int = 0) -> dict:
+            quant: str = "", quant_group: int = 0, kv_quant: str = "",
+            spec_tokens: int = 0) -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -356,7 +378,8 @@ def measure(preset: str, batch: int, prompt_len: int, steps: int, warmup: int,
         # (16 GB) would not fit one v5e chip's HBM alongside anything else
         from finchat_tpu.models.quant import init_quantized_llama_params
 
-        params = init_quantized_llama_params(config, jax.random.key(0))
+        params = init_quantized_llama_params(
+            config, jax.random.key(0), mode=quant, group_size=quant_group)
     else:
         params = init_params(config, jax.random.key(0))
     engine = InferenceEngine(config, params, engine_cfg, attn_backend=attn,
@@ -587,7 +610,8 @@ def measure(preset: str, batch: int, prompt_len: int, steps: int, warmup: int,
 def measure_decode_loop_sweep(
     preset: str, batch: int, prompt_len: int, steps: int, warmup: int,
     page_size: int, max_seq_len: int, attn: str | None,
-    quant: str = "", kv_quant: str = "", depths: tuple = (1, 4, 8),
+    quant: str = "", quant_group: int = 0, kv_quant: str = "",
+    depths: tuple = (1, 4, 8),
 ) -> dict:
     """Sweep the fused multi-step decode loop: for each depth K, time
     blocks of K decode iterations per device dispatch and report tok/s,
@@ -625,7 +649,8 @@ def measure_decode_loop_sweep(
     if quant:
         from finchat_tpu.models.quant import init_quantized_llama_params
 
-        params = init_quantized_llama_params(config, jax.random.key(0))
+        params = init_quantized_llama_params(
+            config, jax.random.key(0), mode=quant, group_size=quant_group)
     else:
         params = init_params(config, jax.random.key(0))
     engine = InferenceEngine(config, params, engine_cfg, attn_backend=attn,
@@ -723,7 +748,8 @@ def measure_decode_loop_sweep(
 def measure_session_sweep(
     preset: str, batch: int, prompt_len: int, steps: int, warmup: int,
     page_size: int, max_seq_len: int, attn: str | None,
-    quant: str = "", kv_quant: str = "", turns: int = 4,
+    quant: str = "", quant_group: int = 0, kv_quant: str = "",
+    turns: int = 4,
 ) -> dict:
     """Multi-turn conversation benchmark of the session KV cache: one
     conversation whose every turn's prompt extends the previous turn's
@@ -769,7 +795,8 @@ def measure_session_sweep(
         if quant:
             from finchat_tpu.models.quant import init_quantized_llama_params
 
-            params = init_quantized_llama_params(config, jax.random.key(0))
+            params = init_quantized_llama_params(
+            config, jax.random.key(0), mode=quant, group_size=quant_group)
         else:
             params = init_params(config, jax.random.key(0))
         engine = InferenceEngine(config, params, engine_cfg, attn_backend=attn,
@@ -1909,6 +1936,329 @@ def measure_freerun_sweep(smoke: bool = False) -> dict:
     }
 
 
+def measure_quant_sweep(smoke: bool = False) -> dict:
+    """Benchmark the quantized serving plane end-to-end (ISSUE 14),
+    CPU-runnable through the REAL scheduler on the tiny fp32 config (fp32
+    pins the greedy byte-identity gates the way every sweep here does).
+
+    Mode grid — bf16 (unquantized), int8-w (weight-only), int8-w+int8-KV
+    (the full quantized plane), int4-w (packed nibbles) — each measured
+    for:
+
+    - decode tok/s and turn-1 TTFT (reported; CPU is compute-bound, so
+      weight-dequant ADDS work here — the HBM-traffic win is on-chip,
+      PERF_quant.md regime analysis);
+    - page-pool capacity per HBM byte (kv_cache.page_hbm_bytes): the
+      int8-KV pool must fit >= 1.75x the bf16 pool's pages in the same
+      budget (~2x minus the fp32 scale planes) — the deeper-batches lever;
+    - a prefill-logit quality envelope vs the bf16 run (max relative
+      logit delta on a fixed probe prompt; a mode past its bound bumps
+      finchat_quant_envelope_exceeded_total and fails the gate);
+    - session offload -> disk spill -> restore under each mode: turn 2
+      resumes from restored KV and must be BYTE-IDENTICAL to a cold
+      re-prefill of the same turn (exact by construction — int8 page
+      ints and fp32 scale planes round-trip bit-exactly), and for the
+      int8-KV mode the disk record's payload must equal the RAM entry's
+      snapshot byte-for-byte INCLUDING the scale planes;
+    - freerun composition: an int8-KV engine at freerun_rounds=4 must
+      still capture (dispatches/round < 1 on the coexist counters) with
+      streams byte-identical to its host-stepped twin;
+    - a zero-leak audit of every stopped scheduler.
+    """
+    import asyncio
+    import dataclasses
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from finchat_tpu.analysis.sanitizers import scheduler_leak_report
+    from finchat_tpu.engine.engine import InferenceEngine
+    from finchat_tpu.engine.kv_cache import page_hbm_bytes, pages_needed
+    from finchat_tpu.engine.sampler import SamplingParams
+    from finchat_tpu.engine.scheduler import ContinuousBatchingScheduler
+    from finchat_tpu.models.llama import PRESETS, init_params
+    from finchat_tpu.models.quant import init_quantized_llama_params
+    from finchat_tpu.utils.config import EngineConfig
+    from finchat_tpu.utils.metrics import METRICS
+
+    config = dataclasses.replace(PRESETS["tiny"], dtype=jnp.float32)
+    page_size = 16
+    chunk = 32
+    n_new = 16 if smoke else 24
+    p1_len, suffix_len = 60, 20
+    total_len = p1_len + suffix_len + 2 * n_new + page_size
+    max_seq_len = total_len + 2 * page_size
+    pps = pages_needed(max_seq_len, page_size)
+    rng = np.random.default_rng(0)
+    probe = rng.integers(1, config.vocab_size, size=40).tolist()
+    p1 = rng.integers(1, config.vocab_size, size=p1_len).tolist()
+    suffix = rng.integers(1, config.vocab_size, size=suffix_len).tolist()
+    # envelope bounds per mode (relative max logit delta vs bf16 on the
+    # probe prefill): int8 is per-channel weight rounding only; the KV
+    # rounding adds on top; int4 is ~16x coarser than int8
+    ENVELOPE = {"int8": 0.10, "int8+kv8": 0.25, "int4": 0.60}
+    MODES = (("bf16", "", ""), ("int8", "int8", ""),
+             ("int8+kv8", "int8", "int8"), ("int4", "int4", ""))
+
+    def make_params(quant):
+        if quant:
+            return init_quantized_llama_params(config, jax.random.key(0),
+                                               mode=quant)
+        return init_params(config, jax.random.key(0))
+
+    def build(quant, kv_quant, *, session_bytes=0, disk_path="", freerun=1,
+              loop_depth=1):
+        ecfg = EngineConfig(
+            max_seqs=4, page_size=page_size, num_pages=4 * pps + 8,
+            max_seq_len=max_seq_len, prefill_chunk=chunk,
+            session_cache=session_bytes > 0, session_cache_bytes=session_bytes,
+            session_cache_disk_path=disk_path, kv_quant=kv_quant,
+            freerun_rounds=freerun, decode_loop_depth=loop_depth,
+        )
+        engine = InferenceEngine(config, make_params(quant), ecfg,
+                                 quant=quant)
+        return engine, ContinuousBatchingScheduler(engine, eos_id=-1)
+
+    async def stream(sched, seq_id, prompt, conv=None):
+        t0 = time.perf_counter()
+        handle = await sched.submit(
+            seq_id, prompt, SamplingParams(temperature=0.0, max_new_tokens=n_new),
+            conversation_id=conv,
+        )
+        toks, ttft = [], None
+        while True:
+            ev = await handle.events.get()
+            if ev["type"] == "token":
+                if ttft is None:
+                    ttft = time.perf_counter() - t0
+                toks.append(ev["token_id"])
+            elif ev["type"] == "done":
+                return toks, ttft
+            else:
+                raise RuntimeError(str(ev))
+
+    def run_mode(label, quant, kv_quant):
+        """One mode's serving measurement; returns the per-mode record."""
+        # quality envelope: a probe prefill's logits on a throwaway slot
+        # (reset afterwards; the scheduler owns slots from here on)
+        engine, sched = build(quant, kv_quant, session_bytes=32 << 20,
+                              disk_path=tempfile.mkdtemp(prefix="quantskv-"))
+        engine.set_page_table_row(0, list(range(1, pages_needed(len(probe), page_size) + 1)))
+        probe_logits = np.asarray(engine.prefill(0, probe))
+        engine.reset_slot(0)
+
+        leaks: list = []
+        rec: dict = {"mode": label}
+
+        async def go():
+            await sched.start()
+            try:
+                t0 = time.perf_counter()
+                toks1, ttft1 = await stream(sched, f"{label}-t1", p1, "qconv")
+                rec["ttft_ms_turn1"] = round(1000 * ttft1, 1)
+                # decode rate: first token lands at ttft, the remaining
+                # n_new-1 tokens span (elapsed - ttft) — excluding prefill,
+                # which would otherwise dominate and mask per-mode decode
+                # deltas (the column PERF_quant.md's regime analysis reads)
+                decode_wall = max(time.perf_counter() - t0 - ttft1, 1e-9)
+                rec["decode_tok_s"] = round((n_new - 1) / decode_wall, 1)
+                history = p1 + toks1
+                # scale-plane disk roundtrip (int8-KV): the RAM entry's
+                # snapshot vs its landed disk record, byte-for-byte
+                cache = sched.session_cache
+                cache.disk.flush()
+                entry, payload = cache.get("qconv"), cache.disk.load("qconv")
+                rec["disk_roundtrip_identical"] = bool(
+                    entry is not None and payload is not None
+                    and np.array_equal(entry.token_ids, payload["token_ids"])
+                    and all(
+                        (a is None and b is None)
+                        or (a is not None and b is not None and np.array_equal(a, b))
+                        for a, b in zip(entry.snap, payload["snap"])
+                    )
+                )
+                chunks0 = METRICS.snapshot().get("finchat_prefill_seconds_count", 0)
+                toks2, _ = await stream(sched, f"{label}-t2", history + suffix, "qconv")
+                rec["prefill_chunks_turn2_resumed"] = int(
+                    METRICS.snapshot().get("finchat_prefill_seconds_count", 0) - chunks0
+                )
+                return history, toks2
+            finally:
+                await sched.stop()
+
+        history, toks2_resumed = asyncio.run(go())
+        leaks += scheduler_leak_report(sched)
+
+        # cold twin: same turn 2, fresh engine, session cache OFF — the
+        # byte-identity-where-exact gate (restored pages must decode
+        # exactly like recomputed ones at fp32)
+        engine_c, sched_c = build(quant, kv_quant)
+
+        async def go_cold():
+            await sched_c.start()
+            try:
+                await stream(sched_c, f"{label}-c1", p1)
+                chunks0 = METRICS.snapshot().get("finchat_prefill_seconds_count", 0)
+                toks, _ = await stream(sched_c, f"{label}-c2", history + suffix)
+                return toks, int(
+                    METRICS.snapshot().get("finchat_prefill_seconds_count", 0) - chunks0
+                )
+            finally:
+                await sched_c.stop()
+
+        toks2_cold, chunks_cold = asyncio.run(go_cold())
+        leaks += scheduler_leak_report(sched_c)
+        rec["prefill_chunks_turn2_cold"] = chunks_cold
+        rec["resumed_vs_cold_identical"] = toks2_resumed == toks2_cold
+        rec["resume_saved_chunks"] = chunks_cold - rec["prefill_chunks_turn2_resumed"]
+
+        # page-pool accounting (the HBM lever, computed not allocated)
+        pb = page_hbm_bytes(config, page_size, kv_quant)
+        rec["page_bytes"] = pb
+        conv_pages = pages_needed(len(history) + suffix_len + n_new, page_size)
+        rec["pages_per_conversation"] = conv_pages
+        rec["conversation_kv_bytes"] = conv_pages * pb
+        rec["leaks"] = leaks
+        return rec, probe_logits
+
+    records, probe_by_mode = [], {}
+    for label, quant, kv_quant in MODES:
+        rec, lg = run_mode(label, quant, kv_quant)
+        probe_by_mode[label] = lg
+        records.append(rec)
+        print(f"[bench] quant {label}: ttft {rec['ttft_ms_turn1']} ms, "
+              f"turn-2 chunks {rec['prefill_chunks_turn2_cold']} cold -> "
+              f"{rec['prefill_chunks_turn2_resumed']} resumed, "
+              f"resumed==cold {rec['resumed_vs_cold_identical']}",
+              file=sys.stderr, flush=True)
+
+    base_logits = probe_by_mode["bf16"]
+    denom = float(np.max(np.abs(base_logits)))
+    envelope_ok = True
+    for rec in records:
+        if rec["mode"] == "bf16":
+            rec["envelope_rel_delta"] = 0.0
+            continue
+        delta = float(np.max(np.abs(probe_by_mode[rec["mode"]] - base_logits)))
+        rec["envelope_rel_delta"] = round(delta / denom, 4)
+        rec["envelope_bound"] = ENVELOPE[rec["mode"]]
+        if rec["envelope_rel_delta"] > rec["envelope_bound"]:
+            METRICS.inc("finchat_quant_envelope_exceeded_total")
+            envelope_ok = False
+
+    by_mode = {r["mode"]: r for r in records}
+    pool_ratio = by_mode["bf16"]["page_bytes"] / by_mode["int8+kv8"]["page_bytes"]
+    # the sweep serves fp32 (identity discipline), which overstates the
+    # KV saving; report the PRODUCT-shape ratio too — llama3-8b bf16 at
+    # the on-chip page size, computed analytically (page_hbm_bytes):
+    # ~1.94x (the fp32 scale planes cost ~3% there, vs ~50% at the tiny
+    # sweep shapes where 2 KV heads pad to 8 scale rows)
+    cfg_8b = PRESETS["llama3-8b"]
+    pool_ratio_8b = (page_hbm_bytes(cfg_8b, 256)
+                     / page_hbm_bytes(cfg_8b, 256, "int8"))
+
+    # freerun composition: int8-KV at freerun_rounds 1 vs 4 — captures
+    # must still engage (dispatches/round < 1) with identical streams.
+    # Same loop depth and the SAME long prompt at both levels (the only
+    # difference under test is the capture itself).
+    fr_long_prompt = rng.integers(1, config.vocab_size, size=3 * chunk + 3).tolist()
+
+    def run_freerun(freerun):
+        engine, sched = build("int8", "int8", freerun=freerun, loop_depth=2)
+        engine.warmup()
+        long_prompt = fr_long_prompt
+        win = {}
+
+        async def go():
+            await sched.start()
+            try:
+                outs = [[] for _ in range(2)]
+
+                async def drain(h, o):
+                    while True:
+                        ev = await h.events.get()
+                        if ev["type"] == "token":
+                            o.append(ev["token_id"])
+                        elif ev["type"] == "done":
+                            return
+                        else:
+                            raise RuntimeError(str(ev))
+
+                handles = [
+                    await sched.submit(
+                        f"fr{freerun}-d{i}", p1[: 12 + 6 * i],
+                        SamplingParams(temperature=0.0, max_new_tokens=40),
+                    )
+                    for i in range(2)
+                ]
+                tasks = [asyncio.create_task(drain(h, o))
+                         for h, o in zip(handles, outs)]
+                while any(len(o) < 2 for o in outs):
+                    await asyncio.sleep(0.002)
+                snap0 = METRICS.snapshot()
+                lh = await sched.submit(
+                    f"fr{freerun}-long", long_prompt,
+                    SamplingParams(temperature=0.0, max_new_tokens=8),
+                )
+                lo: list = []
+                await asyncio.gather(*tasks, asyncio.create_task(drain(lh, lo)))
+                await asyncio.sleep(0.05)  # attribution lands next tick
+                snap1 = METRICS.snapshot()
+                for k in ("finchat_coexist_dispatches_total",
+                          "finchat_coexist_rounds_total",
+                          "finchat_freerun_dispatches_total"):
+                    win[k] = snap1.get(k, 0) - snap0.get(k, 0)
+                return outs + [lo]
+            finally:
+                await sched.stop()
+
+        streams = asyncio.run(go())
+        leaks = scheduler_leak_report(sched)
+        dpr = win["finchat_coexist_dispatches_total"] / max(
+            win["finchat_coexist_rounds_total"], 1.0)
+        return streams, dpr, win, leaks
+
+    fr_streams_1, _dpr1, _w1, leaks1 = run_freerun(1)
+    fr_streams_4, dpr4, win4, leaks4 = run_freerun(4)
+    freerun_identical = fr_streams_1 == fr_streams_4
+    print(f"[bench] quant freerun(int8-KV): dispatches/round {dpr4:.3f} @4 "
+          f"(captures {win4['finchat_freerun_dispatches_total']}), "
+          f"identical={freerun_identical}; kv8 pool ratio {pool_ratio:.2f}x",
+          file=sys.stderr, flush=True)
+
+    all_leaks = sum((r.pop("leaks") for r in records), []) + leaks1 + leaks4
+    return {
+        "metric": "quant_sweep",
+        "unit": "tok/s, page bytes, rel logit delta",
+        "smoke": smoke,
+        "model": "tiny (fp32 — the identity-gate discipline)",
+        "page_size": page_size,
+        "prefill_chunk": chunk,
+        "new_tokens_per_turn": n_new,
+        "sweep": records,
+        "kv8_pool_ratio": round(pool_ratio, 3),
+        "kv8_pool_ratio_8b_bf16": round(pool_ratio_8b, 3),
+        "kv8_pool_at_least_1_75x": pool_ratio >= 1.75 and pool_ratio_8b >= 1.9,
+        "envelope_ok": envelope_ok,
+        "resumed_identical_all_modes": all(
+            r["resumed_vs_cold_identical"] for r in records
+        ),
+        "resume_saved_chunks_all_modes": all(
+            r["resume_saved_chunks"] > 0 for r in records
+        ),
+        "scale_planes_roundtrip": by_mode["int8+kv8"]["disk_roundtrip_identical"],
+        "freerun_dispatches_per_round_int8kv": round(dpr4, 3),
+        "freerun_engaged": win4["finchat_freerun_dispatches_total"] >= 1,
+        "freerun_outputs_identical": freerun_identical,
+        "zero_leaks": not all_leaks,
+        "leak_report": all_leaks,
+        "platform": jax.devices()[0].platform,
+        "device": str(jax.devices()[0]),
+    }
+
+
 def measure_chaos_sweep(smoke: bool = False, rates: tuple = (0.05, 0.2)) -> dict:
     """Chaos benchmark of the resilience plane (ISSUE 5), CPU-runnable
     through the REAL scheduler on the tiny fp32 config (fp32 pins greedy
@@ -2913,8 +3263,8 @@ def spawn_worker(args: argparse.Namespace, platform: str, timeout: float) -> dic
            "--platform", platform, "--tpu-timeout", str(args.tpu_timeout),
            "--measure-budget", str(args.measure_budget)]
     for flag in ("preset", "batch", "prompt_len", "steps", "warmup",
-                 "page_size", "max_seq_len", "attn", "quant", "kv_quant",
-                 "spec_tokens"):
+                 "page_size", "max_seq_len", "attn", "quant", "quant_group",
+                 "kv_quant", "spec_tokens"):
         v = getattr(args, flag)
         if v is not None:
             cmd += ["--" + flag.replace("_", "-"), str(v)]
@@ -2951,6 +3301,8 @@ def spawn_worker(args: argparse.Namespace, platform: str, timeout: float) -> dic
     if args.fleet_sweep or args.fleet_smoke:
         cmd += ["--fleet-replicas", str(args.fleet_replicas)]
         cmd += ["--fleet-smoke"] if args.fleet_smoke else ["--fleet-sweep"]
+    if args.quant_sweep or args.quant_smoke:
+        cmd += (["--quant-smoke"] if args.quant_smoke else ["--quant-sweep"])
     if args.trace_overhead:
         cmd += ["--trace-overhead"]
     print(f"[bench] spawning {platform} worker (timeout {timeout:.0f}s)",

@@ -91,7 +91,8 @@ async def run_load(
         # exceeds one v5e chip's HBM — same policy as bench.py)
         from finchat_tpu.models.quant import init_quantized_llama_params
 
-        params = init_quantized_llama_params(config, jax.random.key(0))
+        params = init_quantized_llama_params(config, jax.random.key(0),
+                                             mode=quant)
     else:
         params = init_params(config, jax.random.key(0))
     engine = InferenceEngine(config, params, engine_cfg, quant=quant)
@@ -223,7 +224,7 @@ def main() -> None:
                    help="prompt-lookup draft depth (greedy slots only; "
                         "pair with --temperature 0)")
     p.add_argument("--temperature", type=float, default=0.5)
-    p.add_argument("--quant", choices=("int8",), default=None)
+    p.add_argument("--quant", choices=("int8", "int4"), default=None)
     p.add_argument("--kv-quant", choices=("int8",), default=None)
     p.add_argument("--arrival-qps", type=float, default=0.0,
                    help="Poisson session arrival rate (steady-state TTFT); "

@@ -45,20 +45,23 @@ def load_llama_params(
     *,
     shardings: dict[str, Any] | None = None,
     quant: str = "",
+    quant_group: int = 0,
 ) -> dict[str, Any]:
     """Load HF Llama weights into the stacked pytree layout.
 
     ``shardings``: optional map from our param path (e.g. ``layers/attn_q``)
     to a ``jax.sharding.Sharding`` for direct sharded placement.
 
-    ``quant="int8"``: quantize each matmul weight AT LOAD, one tensor at a
-    time (models/quant.py) — the device never holds more than one bf16
-    leaf alongside the int8 tree, so llama3-8b (16 GB bf16) loads onto one
-    16 GB v5e chip. Same numerics as quantizing after a full-precision
-    load.
+    ``quant="int8"`` / ``"int4"``: quantize each matmul weight AT LOAD, one
+    tensor at a time (models/quant.py) — the device never holds more than
+    one bf16 leaf alongside the quantized tree, so llama3-8b (16 GB bf16)
+    loads onto one 16 GB v5e chip. Same numerics as quantizing after a
+    full-precision load. ``quant_group`` is the int4 scale group size
+    along K (0 = per-output-channel).
     """
-    if quant and quant != "int8":
-        raise ValueError(f"unknown quant mode {quant!r} (supported: 'int8')")
+    from finchat_tpu.models.quant import validate_quant_mode
+
+    validate_quant_mode(quant)
     path = Path(checkpoint_dir)
     tensors: dict[str, np.ndarray] = {}
     for shard in _open_shards(path):
@@ -103,7 +106,7 @@ def load_llama_params(
                 # per-slice for stacked leaves: whole-leaf quantize's fp32
                 # upcast transient (7.5 GB on the 8B mlp stack) would OOM
                 # next to the already-quantized leaves
-                qt = quantize_stacked(arr)
+                qt = quantize_stacked(arr, mode=quant, group_size=quant_group)
                 # free the bf16 copy before the next tensor materializes
                 jax.block_until_ready(qt.q)  # finchat-lint: disable=event-loop-blocking -- checkpoint-load memory backpressure by design (one quantized slice's transients at a time); startup path, runs before anything serves
                 del arr

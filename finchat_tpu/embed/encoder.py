@@ -23,8 +23,32 @@ import jax.numpy as jnp
 import numpy as np
 from jax import Array
 
+from finchat_tpu.models.quant import dense as quant_dense
+from finchat_tpu.models.quant import quantize_stacked
 from finchat_tpu.models.tokenizer import Tokenizer
 from finchat_tpu.ops.refs import mha_reference
+
+# the encoder's matmul leaves — what int8 weight-only quantization covers
+# (embeddings are gathers, LayerNorm scales/biases are precision-sensitive
+# and tiny; biases ride unquantized like the decoder's norms)
+BERT_QUANT_LEAVES = ("qkv", "attn_out", "mlp_in", "mlp_out")
+
+
+def quantize_bert_params(params: dict[str, Any]) -> dict[str, Any]:
+    """Int8-quantize the encoder's stacked matmul weights (ISSUE 14): the
+    SAME ``QTensor`` machinery as the decoder (models/quant.py — per-slice
+    ``quantize_stacked``, per-output-column scales, inline dequant fused
+    into the dot), so the retrieval plane rides the serving quant mode.
+    Idempotent on already-quantized trees."""
+    from finchat_tpu.models.quant import Q4Tensor, QTensor
+
+    layers = {
+        name: (leaf if isinstance(leaf, (QTensor, Q4Tensor))
+               or name not in BERT_QUANT_LEAVES
+               else quantize_stacked(leaf))
+        for name, leaf in params["layers"].items()
+    }
+    return {**params, "layers": layers}
 
 
 @dataclass(frozen=True)
@@ -111,23 +135,27 @@ def encode_batch(
     valid = (jnp.arange(S)[None, :] < lengths[:, None])  # [B, S]
 
     def body(x, layer):
-        qkv = x @ layer["qkv"] + layer["qkv_bias"]  # [B,S,3D]
+        # quant_dense = plain ``x @ w`` on unquantized leaves, inline
+        # int8 dequant (fused into the dot's operand read) on QTensor
+        # leaves — the embed.quant path (quantize_bert_params)
+        qkv = quant_dense(x, layer["qkv"]) + layer["qkv_bias"]  # [B,S,3D]
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(B, S, c.n_heads, c.head_dim)
         k = k.reshape(B, S, c.n_heads, c.head_dim)
         v = v.reshape(B, S, c.n_heads, c.head_dim)
         attn = mha_reference(q, k, v, causal=False, kv_len=lengths)
         x = _layer_norm(
-            x + attn.reshape(B, S, -1) @ layer["attn_out"] + layer["attn_out_bias"],
+            x + quant_dense(attn.reshape(B, S, -1), layer["attn_out"])
+            + layer["attn_out_bias"],
             layer["ln1_scale"], layer["ln1_bias"], c.norm_eps,
         )
         # exact (erf) GELU — what BERT/bge checkpoints were trained with
         h = jax.nn.gelu(
-            (x @ layer["mlp_in"] + layer["mlp_in_bias"]).astype(jnp.float32),
+            (quant_dense(x, layer["mlp_in"]) + layer["mlp_in_bias"]).astype(jnp.float32),
             approximate=False,
         ).astype(x.dtype)
         x = _layer_norm(
-            x + h @ layer["mlp_out"] + layer["mlp_out_bias"],
+            x + quant_dense(h, layer["mlp_out"]) + layer["mlp_out_bias"],
             layer["ln2_scale"], layer["ln2_bias"], c.norm_eps,
         )
         return x, None
@@ -153,9 +181,18 @@ class EmbeddingEncoder:
     """
 
     def __init__(self, config: BertConfig, params: dict[str, Any], tokenizer: Tokenizer,
-                 *, batch_size: int = 64):
+                 *, batch_size: int = 64, quant: str = ""):
+        if quant and quant != "int8":
+            raise ValueError(
+                f"unknown embed quant mode {quant!r} (supported: 'int8')"
+            )
         self.config = config
-        self.params = params
+        # embed.quant: the retrieval plane rides the serving quant mode —
+        # int8 weight-only via the decoder's QTensor machinery (ISSUE 14);
+        # quality gate: quantized-vs-fp32 top-k overlap >= 0.99
+        # (tests/test_quant_serving.py, bench --quant-sweep)
+        self.params = quantize_bert_params(params) if quant else params
+        self.quant = quant
         self.tokenizer = tokenizer
         self.batch_size = batch_size
 

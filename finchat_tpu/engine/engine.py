@@ -1137,11 +1137,12 @@ class InferenceEngine:
     """
 
     def __init__(self, config: LlamaConfig, params: dict[str, Any], engine_cfg: EngineConfig,
-                 mesh=None, attn_backend: str | None = None, quant: str = ""):
+                 mesh=None, attn_backend: str | None = None, quant: str = "",
+                 quant_group: int = 0):
+        from finchat_tpu.models.quant import validate_quant_mode
         from finchat_tpu.ops.dispatch import attention_backend
 
-        if quant and quant != "int8":
-            raise ValueError(f"unknown quant mode {quant!r} (supported: 'int8')")
+        validate_quant_mode(quant)
         if engine_cfg.compilation_cache_dir:
             # persistent XLA compilation cache: warmup's compiles land on
             # disk so a restarted process reloads them instead of
@@ -1198,14 +1199,27 @@ class InferenceEngine:
             state = shard_decode_state(state, mesh, config.n_kv_heads)
         if quant:
             # after sharding on purpose: quantize is plain jnp, so q/scale
-            # inherit each weight's GSPMD placement (models/quant.py)
+            # inherit each weight's GSPMD placement (models/quant.py);
+            # idempotent on trees the checkpoint loader already quantized
             from finchat_tpu.models.quant import quantize_llama_params
 
-            params = quantize_llama_params(params)
+            params = quantize_llama_params(params, mode=quant,
+                                           group_size=quant_group)
         self.quant = quant
+        self.quant_group = quant_group
         self.params = params
         self.state = state
         self.sp_mode = self._resolve_sp_mode(engine_cfg.sp_mode)
+
+    @property
+    def quant_label(self) -> str:
+        """The serving quant mode as ONE label ("bf16", "int8", "int4",
+        with "+kv8" when the page pool is int8) — stamped on dispatch
+        trace events and the finchat_quant_* gauges so traced timelines
+        and dashboards distinguish quantized dispatches (ISSUE 14). Must
+        stay within tracing.QUANT_MODES (pinned by tests)."""
+        base = self.quant or "bf16"
+        return base + ("+kv8" if self.kv_quant else "")
 
     def _resolve_sp_mode(self, sp_mode: str) -> str:
         """Validate the configured SP mode against this model/mesh; Ulysses
@@ -1660,10 +1674,15 @@ class InferenceEngine:
         # the scheduler re-emits it as the finchat_warmup_compiled_variants
         # gauge through its (possibly replica-labeled) metrics view
         self.compiled_variants = n_variants
+        # the variant COUNT is quant-independent by construction (weight
+        # dtype never keys a jit cache entry — the quantized tree swaps in
+        # under the same traced shapes), so the collapsed-matrix gauge
+        # stays comparable across modes; the label makes the mode visible
         logger.info(
-            "engine warmup: prefill batches %s + %d serving variants "
+            "engine warmup [%s]: prefill batches %s + %d serving variants "
             "compiled in %.1fs%s",
-            prefill_batch_sizes, n_variants, elapsed, cache_note,
+            self.quant_label, prefill_batch_sizes, n_variants, elapsed,
+            cache_note,
         )
         return elapsed
 

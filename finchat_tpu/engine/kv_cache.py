@@ -292,10 +292,22 @@ def scatter_pages_device(
     Session-cache RESTORE path. An XLA scatter — one full-cache copy per
     restore, amortized over a whole turn (the same trade ``scatter_kv_chunk``
     makes per prefill chunk); never called from a jitted step."""
+    import numpy as np
+
     ids = jnp.asarray(page_ids, jnp.int32)
     k, v, ks, vs = host
     n = len(page_ids)
     assert k.shape[1] >= n, f"snapshot holds {k.shape[1]} pages, need {n}"
+    # cross-MODE snapshots must fail loudly, not cast silently: an int8
+    # snapshot .set() into a bf16 pool (or a bf16 one into int8) would
+    # value-cast into plausible-looking garbage KV. Callers refuse earlier
+    # (session tier / import guards, counted); this is the last line.
+    if np.dtype(k.dtype) != np.dtype(k_pages.dtype):
+        raise ValueError(
+            f"snapshot dtype {np.dtype(k.dtype).name} does not match the "
+            f"page-pool dtype {np.dtype(k_pages.dtype).name} (cross-mode "
+            "restore refused)"
+        )
     k_pages = k_pages.at[:, ids].set(jnp.asarray(k[:, :n]))
     v_pages = v_pages.at[:, ids].set(jnp.asarray(v[:, :n]))
     if k_pages.dtype == jnp.int8:

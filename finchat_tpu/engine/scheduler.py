@@ -411,6 +411,24 @@ class ContinuousBatchingScheduler:
         self._trace_track = (
             f"replica-{replica_id}" if replica_id is not None else "engine"
         )
+        # quantized serving plane (ISSUE 14): the engine's quant mode as
+        # one label on every dispatch trace event (timelines distinguish
+        # bf16/int8/int4 dispatches), plus the finchat_quant_* family —
+        # mode gauges (bits per weight / per KV element) and pre-seeded
+        # fallback/envelope counters so a mode flip or a refused
+        # cross-mode restore is visible from zero
+        self._quant_label = getattr(engine, "quant_label", "bf16")
+        _wbits = {"": None, "int8": 8, "int4": 4}.get(
+            getattr(engine, "quant", ""))
+        _elem_bits = 8 * np.dtype(engine.config.dtype).itemsize
+        self.metrics.set_gauge("finchat_quant_weight_bits",
+                               _wbits if _wbits else _elem_bits)
+        self.metrics.set_gauge(
+            "finchat_quant_kv_bits",
+            8 if getattr(engine, "kv_quant", "") else _elem_bits,
+        )
+        self.metrics.inc("finchat_quant_dequant_fallbacks_total", 0.0)
+        self.metrics.inc("finchat_quant_envelope_exceeded_total", 0.0)
         # shared-prefix KV cache: matched at admission so identical prompt
         # heads (the constant system prompt every conversation shares) are
         # prefilled ONCE per process instead of per request — see
@@ -502,6 +520,9 @@ class ContinuousBatchingScheduler:
                     disk = SessionDiskTier(
                         disk_path, cfg.session_cache_disk_bytes,
                         metrics=self.metrics,
+                        # records written under the other page-pool dtype
+                        # are refused (counted), never scattered (ISSUE 14)
+                        kv_quant=engine.kv_quant,
                     )
                 except Exception as e:  # durability is best-effort
                     logger.error("session disk tier unavailable at %s: %s",
@@ -682,7 +703,7 @@ class ContinuousBatchingScheduler:
         nothing."""
         TRACER.event("dispatch", ts=ts, dur=dur, track=self._trace_track,
                      args={"kind": kind, "n": self._dispatch_tally,
-                           "rows": rows})
+                           "quant": self._quant_label, "rows": rows})
 
     def _ring_routed(self, handle: SequenceHandle) -> bool:
         """Does this prefilling handle take the seq-sharded ring path this
@@ -1410,6 +1431,23 @@ class ContinuousBatchingScheduler:
         absolute, so the snapshot's pages are meaningless without the
         head KV below them."""
         if payload is None or self.session_cache is None:
+            return False
+        from finchat_tpu.engine.session_cache import snap_kv_mode
+
+        if (payload.get("snap") is not None
+                and snap_kv_mode(payload["snap"]) != self.engine.kv_quant):
+            # cross-MODE snapshot (a handoff or disk record from an engine
+            # serving the other page-pool dtype): scattering it would
+            # value-cast into garbage KV — refuse, count the dequant
+            # fallback, resume cold (kv_cache.scatter_pages_device is the
+            # raising last line behind this counted gate)
+            logger.warning(
+                "session import for %s refused: snapshot kv mode %r vs "
+                "engine kv_quant %r — cold start",
+                payload.get("conversation_id"),
+                snap_kv_mode(payload["snap"]), self.engine.kv_quant,
+            )
+            self.metrics.inc("finchat_quant_dequant_fallbacks_total")
             return False
         prefix_len = int(payload["prefix_len"])
         entry_ref = None

@@ -101,6 +101,39 @@ def conversation_of(key: str) -> str:
 # Snapshot layout throughout this module: a (k, v, k_scales | None,
 # v_scales | None) tuple of host arrays, each [L, n_pages, ...] — the
 # gather_pages_host / scatter_pages_device contract (engine/kv_cache.py).
+# Under ``kv_quant="int8"`` the data planes are int8 and the scale planes
+# are REAL fp32 arrays — both travel through every snapshot path (RAM LRU,
+# disk records, fleet export) byte-identically; scales are covered by the
+# record CRC like everything else in the payload.
+
+
+def snap_kv_mode(snap: tuple | None) -> str:
+    """The KV quant mode a snapshot was taken under: "int8" when it
+    carries scale planes, "" (native dtype) otherwise. ``None`` snapshots
+    (prefix-only entries) are mode-agnostic — restorable under either."""
+    if snap is None or len(snap) < 3 or snap[2] is None:
+        return ""
+    return "int8"
+
+
+def _dtype_name(dt) -> str:
+    """Serializable dtype identity. ``np.dtype.str`` is NOT it: ml_dtypes
+    dtypes (bfloat16) stringify as ``<V2`` (raw void), which round-trips
+    to a void dtype — a bf16 snapshot written that way can never restore
+    (latent since ISSUE 7; record version 2 fixes it). ``.name`` gives
+    'bfloat16'/'float32'/'int8', resolvable by :func:`resolve_dtype`."""
+    return np.dtype(dt).name
+
+
+def resolve_dtype(name: str) -> np.dtype:
+    """Inverse of :func:`_dtype_name`, also accepting v1 records' dtype
+    strings ('<f4' etc.). Unknown names raise — the caller quarantines."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
 
 
 def _snap_nbytes(snap: tuple | None) -> int:
@@ -143,7 +176,7 @@ class SessionDiskTier:
     """Byte-budgeted LRU of session-KV record files under one directory —
     the durability plane below the host-RAM tier (ISSUE 7).
 
-    Record format (version 1):
+    Record format (version 2; version 1 records remain readable):
 
         b"FSKV" | u8 version | u32 header_len | header JSON | payload
 
@@ -151,12 +184,30 @@ class SessionDiskTier:
     (dtype/shape per array; the shared-prefix head's DEVICE pages are
     never stored — the record is the ``export_entry`` payload shape, so
     a restore re-links against the restoring scheduler's own live head),
-    the payload byte length, and a CRC32 of the payload. Writes go to a
-    ``.tmp`` sibling, fsync, then ``os.replace`` — a record is either
-    whole or absent, never torn. Any read-side anomaly (bad magic,
-    version, truncation, CRC mismatch, or an injected ``disk.restore``
-    fault) QUARANTINES the file (renamed ``*.quarantine``) and returns
-    None: never a crash, never stale KV — the conversation cold-starts.
+    the payload byte length, and a CRC32 of the payload. Version 2
+    (ISSUE 14) additionally stamps the snapshot's KV quant mode (``kv``:
+    "int8" when scale planes travel, "" for native dtype — the scale
+    planes ride the payload and its CRC like every other array) and
+    stores dtypes BY NAME: v1 used ``np.dtype.str``, under which
+    ml_dtypes bfloat16 serializes as raw void (``<V2``) and can never
+    deserialize — v1 bf16 records were unreadable (quarantine → cold
+    start); v2 round-trips every serving dtype. Writes go to a ``.tmp``
+    sibling, fsync, then ``os.replace`` — a record is either whole or
+    absent, never torn. Any read-side anomaly (bad magic, version,
+    truncation, CRC mismatch, or an injected ``disk.restore`` fault)
+    QUARANTINES the file (renamed ``*.quarantine``) and returns None:
+    never a crash, never stale KV — the conversation cold-starts.
+
+    Cross-MODE records (ISSUE 14): a tier constructed with ``kv_quant``
+    refuses records whose snapshot was taken under the OTHER page-pool
+    dtype — a bf16 snapshot scattered into an int8 pool (or vice versa)
+    would serve garbage KV. Refusal is quarantine-STYLE: the record is
+    set aside as ``*.crossmode`` (it is valid, just for a different
+    serving mode — distinct from corruption), counted on
+    ``finchat_quant_dequant_fallbacks_total``, and the conversation
+    cold-starts. The startup sweep applies the same check, so a process
+    restarted under a flipped ``engine.kv_quant`` sets every stale-mode
+    record aside once, up front.
 
     Startup sweeps the directory: ``.tmp`` orphans from a mid-write crash
     are deleted, records whose header or size don't parse are quarantined,
@@ -179,15 +230,19 @@ class SessionDiskTier:
     """
 
     MAGIC = b"FSKV"
-    VERSION = 1
+    VERSION = 2
+    READABLE_VERSIONS = (1, 2)
     SUFFIX = ".skv"
 
     def __init__(self, path: str, budget_bytes: int, metrics=None,
-                 async_writes: bool = True):
+                 async_writes: bool = True, kv_quant: str = ""):
         assert budget_bytes > 0
         self.path = Path(path)
         self.path.mkdir(parents=True, exist_ok=True)
         self.budget_bytes = budget_bytes
+        # the page-pool dtype this tier serves ("" = native): records whose
+        # snapshot was taken under the other mode are refused at load/sweep
+        self.kv_quant = kv_quant
         self.metrics = metrics if metrics is not None else METRICS
         # key -> (filename, nbytes), LRU order (oldest first); guarded by
         # _lock — the writer thread updates it as records land
@@ -244,7 +299,7 @@ class SessionDiskTier:
                     specs.append(None)
                     continue
                 a = np.ascontiguousarray(a)
-                specs.append({"dtype": a.dtype.str, "shape": list(a.shape)})
+                specs.append({"dtype": _dtype_name(a.dtype), "shape": list(a.shape)})
                 chunks.append(a.tobytes())
         payload = b"".join(chunks)
         header = json.dumps({
@@ -252,6 +307,7 @@ class SessionDiskTier:
             "prefix_len": int(prefix_len),
             "n_tokens": int(token_ids.shape[0]),
             "snap": specs,
+            "kv": snap_kv_mode(snap),
             "payload_len": len(payload),
             "crc": zlib.crc32(payload),
         }).encode()
@@ -263,7 +319,7 @@ class SessionDiskTier:
         """(header, payload offset); raises ValueError on any anomaly."""
         if raw[:4] != SessionDiskTier.MAGIC:
             raise ValueError("bad magic")
-        if raw[4] != SessionDiskTier.VERSION:
+        if raw[4] not in SessionDiskTier.READABLE_VERSIONS:
             raise ValueError(f"unknown record version {raw[4]}")
         hlen = int.from_bytes(raw[5:9], "big")
         header = json.loads(raw[9 : 9 + hlen].decode())
@@ -271,6 +327,17 @@ class SessionDiskTier:
         if len(raw) - off != header["payload_len"]:
             raise ValueError("truncated record")
         return header, off
+
+    @staticmethod
+    def _header_kv_mode(header: dict) -> str:
+        """A record's KV quant mode: the v2 ``kv`` stamp, or (v1 records)
+        derived from whether scale-plane specs are present."""
+        if "kv" in header:
+            return header["kv"]
+        specs = header.get("snap")
+        if specs and len(specs) > 2 and specs[2] is not None:
+            return "int8"
+        return ""
 
     @staticmethod
     def _deserialize(raw: bytes) -> dict:
@@ -288,7 +355,7 @@ class SessionDiskTier:
                 if spec is None:
                     arrs.append(None)
                     continue
-                dt = np.dtype(spec["dtype"])
+                dt = resolve_dtype(spec["dtype"])
                 count = int(np.prod(spec["shape"])) if spec["shape"] else 1
                 arrs.append(
                     np.frombuffer(payload, dt, count=count, offset=pos)
@@ -433,7 +500,15 @@ class SessionDiskTier:
             return None
         try:
             inject("disk.restore", key=key)
-            payload = self._deserialize((self.path / entry[0]).read_bytes())
+            raw = (self.path / entry[0]).read_bytes()
+            header, _off = self._read_header(raw)
+            if header.get("snap") and self._header_kv_mode(header) != self.kv_quant:
+                # valid record, WRONG page-pool dtype: scattering it into
+                # this engine's pool would serve garbage KV — set it aside
+                # (quarantine-style, distinct suffix) and cold-start
+                self._refuse_crossmode(key, self._header_kv_mode(header))
+                return None
+            payload = self._deserialize(raw)
             if payload["conversation_id"] != key:
                 raise ValueError("record key mismatch")
         except Exception as e:
@@ -447,6 +522,32 @@ class SessionDiskTier:
             if key in self._index:
                 self._index.move_to_end(key)
         return payload
+
+    def _refuse_crossmode(self, key: str, record_mode: str,
+                          fname: str | None = None) -> None:
+        """Set aside a valid record written under the OTHER KV quant mode
+        (``*.crossmode``; counted as a dequant fallback — the engine falls
+        back to recomputing the prefix instead of serving stored KV).
+        Distinct from :meth:`_quarantine`: the record is not corrupt, and
+        the counter separates mode flips from data damage."""
+        with self._lock:
+            entry = self._index.pop(key, None)
+            if entry is not None:
+                fname, nbytes = entry
+                self._resident -= nbytes
+        if fname is not None:
+            src = self.path / fname
+            try:
+                os.replace(src, self.path / (fname + ".crossmode"))
+            except OSError:
+                src.unlink(missing_ok=True)
+        logger.warning(
+            "session disk tier: record for %s was written under "
+            "kv_quant=%r, this engine serves kv_quant=%r; set aside — "
+            "conversation cold-starts", key, record_mode, self.kv_quant,
+        )
+        self.metrics.inc("finchat_quant_dequant_fallbacks_total")
+        self._publish_gauges()
 
     def _quarantine(self, key: str, fname: str | None = None) -> None:
         with self._lock:
@@ -485,13 +586,22 @@ class SessionDiskTier:
             try:
                 with open(p, "rb") as f:  # finchat-lint: disable=event-loop-blocking -- constructor-time directory sweep: runs once at process start, before the scheduler loop exists
                     head = f.read(9)
-                    if head[:4] != self.MAGIC or head[4] != self.VERSION:
+                    if (head[:4] != self.MAGIC
+                            or head[4] not in self.READABLE_VERSIONS):
                         raise ValueError("bad magic/version")
                     hlen = int.from_bytes(head[5:9], "big")
                     header = json.loads(f.read(hlen).decode())
                 size = p.stat().st_size
                 if size != 9 + hlen + header["payload_len"]:
                     raise ValueError("size mismatch")
+                if header.get("snap") and self._header_kv_mode(header) != self.kv_quant:
+                    # a restart under a flipped engine.kv_quant: set every
+                    # stale-mode record aside once, up front (same check
+                    # load() applies; sweeping keeps the index honest)
+                    self._refuse_crossmode(header["key"],
+                                           self._header_kv_mode(header),
+                                           fname=name)
+                    continue
                 found.append((p.stat().st_mtime, header["key"], name, size))
             except Exception as e:
                 logger.error("session disk tier: sweeping out bad record %s "

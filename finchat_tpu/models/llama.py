@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 from jax import Array, lax
 
-from finchat_tpu.models.quant import QTensor, dense, dequantize
+from finchat_tpu.models.quant import Q4Tensor, QTensor, dense, dequantize
 
 # attention callback signature:
 #   fn(q[B,S,H,D], k[B,S,Hkv,D], v[B,S,Hkv,D], layer_cache, layer_idx) ->
@@ -223,9 +223,9 @@ def moe_mlp(h: Array, layer_params: dict[str, Array], config: LlamaConfig) -> Ar
     onehot = jax.nn.one_hot(top_idx, E, dtype=w.dtype)  # [B,S,k,E]
     gates = jnp.einsum("bske,bsk->bse", onehot, w).astype(h.dtype)  # [B,S,E]
 
-    def expert_mm(spec: str, x: Array, w: Array | QTensor) -> Array:
-        # int8 serving: inline dequant, fused into the dot's operand read
-        if isinstance(w, QTensor):
+    def expert_mm(spec: str, x: Array, w: Array | QTensor | Q4Tensor) -> Array:
+        # int8/int4 serving: inline dequant, fused into the dot's operand read
+        if isinstance(w, (QTensor, Q4Tensor)):
             w = dequantize(w, x.dtype)
         return jnp.einsum(spec, x, w)
 
@@ -340,7 +340,7 @@ def forward(
 def lm_head(params: dict[str, Any], x: Array, *, config: LlamaConfig) -> Array:
     """Project hidden states [..., D] to fp32 logits [..., vocab]."""
     head = params["embed"].T if config.tie_embeddings else params["lm_head"]
-    if isinstance(head, QTensor):
+    if isinstance(head, (QTensor, Q4Tensor)):
         head = dequantize(head, x.dtype)
     return jnp.einsum("...d,dv->...v", x, head, preferred_element_type=jnp.float32)
 

@@ -185,7 +185,7 @@ def shard_params(params: dict[str, Any], shardings: dict[str, Any]) -> dict[str,
             return {k: prune(spec[k], v) for k, v in tree.items()}
         return spec
 
-    from finchat_tpu.models.quant import QTensor
+    from finchat_tpu.models.quant import Q4Tensor, QTensor
 
     def place(x, s):
         if isinstance(x, QTensor):
@@ -200,11 +200,25 @@ def shard_params(params: dict[str, Any], shardings: dict[str, Any]) -> dict[str,
                     x.scale, _fit_sharding(scale_s, x.scale.shape, _leaf_nbytes(x.scale))
                 ),
             )
+        if isinstance(x, Q4Tensor):
+            # int4: q is the weight spec over the PACKED [.., K//2, N]
+            # layout (K-axis shards that stop dividing simply replicate via
+            # _fit_sharding); the per-group scale [..., G, N] keeps the
+            # output axis and replicates the group axis
+            spec = list(s.spec) + [None] * (x.q.ndim - len(s.spec))
+            scale_s = NamedSharding(s.mesh, P(*spec[:-2], None, spec[-1]))
+            return Q4Tensor(
+                q=jax.device_put(x.q, _fit_sharding(s, x.q.shape, _leaf_nbytes(x.q))),
+                scale=jax.device_put(
+                    x.scale, _fit_sharding(scale_s, x.scale.shape, _leaf_nbytes(x.scale))
+                ),
+            )
         return jax.device_put(x, _fit_sharding(s, x.shape, _leaf_nbytes(x)))
 
     pruned = prune(shardings, params)
     return jax.tree.map(
-        place, params, pruned, is_leaf=lambda x: isinstance(x, QTensor)
+        place, params, pruned,
+        is_leaf=lambda x: isinstance(x, (QTensor, Q4Tensor)),
     )
 
 

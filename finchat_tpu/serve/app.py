@@ -315,17 +315,22 @@ def _load_model_artifacts(cfg: AppConfig) -> tuple:
         from finchat_tpu.checkpoints.hf_loader import load_llama_params
 
         # quantize per-tensor AT LOAD so the full bf16 tree never has to
-        # fit in HBM (8B int8 on one 16 GB chip); the engine's own
-        # quantize pass is idempotent on the already-QTensor leaves
+        # fit in HBM (8B int8/int4 on one 16 GB chip); the engine's own
+        # quantize pass is idempotent on the already-quantized leaves
         params = load_llama_params(cfg.model.checkpoint_path, config,
-                                   quant=cfg.model.quant)
+                                   quant=cfg.model.quant,
+                                   quant_group=cfg.model.quant_group)
     else:
         logger.warning("no checkpoint configured; using RANDOM weights (preset=%s)", cfg.model.preset)
         if cfg.model.quant:
-            from finchat_tpu.models.quant import init_quantized_llama_params as init_fn
+            from finchat_tpu.models.quant import init_quantized_llama_params
+
+            params = init_quantized_llama_params(
+                config, jax.random.key(cfg.model.seed),
+                mode=cfg.model.quant, group_size=cfg.model.quant_group,
+            )
         else:
-            init_fn = init_params
-        params = init_fn(config, jax.random.key(cfg.model.seed))
+            params = init_params(config, jax.random.key(cfg.model.seed))
     from finchat_tpu.parallel.mesh import MeshSpec, build_mesh
 
     spec = MeshSpec.from_config(cfg.mesh)
@@ -353,7 +358,8 @@ def make_engine_replica(
     config, params, tokenizer, mesh = artifacts
     metrics = METRICS.labeled(replica=replica_id) if replica_id is not None else None
     engine = InferenceEngine(config, params, cfg.engine, mesh=mesh,
-                             quant=cfg.model.quant)
+                             quant=cfg.model.quant,
+                             quant_group=cfg.model.quant_group)
     if cfg.engine.warmup_on_start:
         engine.warmup()
     scheduler = ContinuousBatchingScheduler(
@@ -1306,7 +1312,8 @@ def build_app(cfg: AppConfig | None = None, *, store: ConversationStore | None =
                 )
             embed_tokenizer = tokenizer or get_tokenizer()
         encoder = EmbeddingEncoder(
-            embed_cfg, embed_params, embed_tokenizer, batch_size=cfg.embed.batch_size
+            embed_cfg, embed_params, embed_tokenizer,
+            batch_size=cfg.embed.batch_size, quant=cfg.embed.quant,
         )
         if cfg.vector.api_key and not cfg.vector.url:
             logger.warning(

@@ -166,7 +166,16 @@ class ModelConfig:
     tokenizer_path: str = ""  # HF tokenizer dir; empty = byte tokenizer
     dtype: str = "bfloat16"
     seed: int = 0
-    quant: str = ""  # "" (bf16) | "int8" weight-only serving (models/quant.py)
+    # weight-only quantized serving (models/quant.py): "" (full precision)
+    # | "int8" (per-output-channel scales) | "int4" (two nibbles per byte,
+    # per-channel or per-group scales) — halves / quarters weight HBM
+    # traffic on the decode hot path. Also FINCHAT_QUANT.
+    quant: str = ""
+    # int4 scale group size along the contraction axis (rows of K per
+    # scale); 0 = one scale per output channel. Smaller groups tighten the
+    # quant-error envelope at ~fp32/group_size extra scale bytes. Ignored
+    # for int8. Also FINCHAT_QUANT_GROUP.
+    quant_group: int = 0
 
 
 @dataclass
@@ -376,6 +385,11 @@ class EmbedConfig:
     # already queued); batch_max = texts per coalesced dispatch.
     batch_window_ms: float = 3.0
     batch_max: int = 32
+    # int8 weight-only quantized encoder (embed/encoder.py
+    # quantize_bert_params — ISSUE 14): the retrieval plane rides the same
+    # QTensor machinery as the decoder; "" = full precision. Gated on
+    # quantized-vs-fp32 top-k overlap >= 0.99. Also FINCHAT_EMBED_QUANT.
+    quant: str = ""
 
 
 @dataclass
@@ -582,6 +596,8 @@ def load_config(
     cfg.model.checkpoint_path = _env("FINCHAT_CHECKPOINT", cfg.model.checkpoint_path)
     cfg.model.tokenizer_path = _env("FINCHAT_TOKENIZER", cfg.model.tokenizer_path)
     cfg.model.quant = _env("FINCHAT_QUANT", cfg.model.quant)
+    cfg.model.quant_group = _env_int("FINCHAT_QUANT_GROUP", cfg.model.quant_group)
+    cfg.embed.quant = _env("FINCHAT_EMBED_QUANT", cfg.embed.quant)
     cfg.embed.checkpoint_path = _env("FINCHAT_EMBED_CHECKPOINT", cfg.embed.checkpoint_path)
     cfg.embed.tokenizer_path = _env("FINCHAT_EMBED_TOKENIZER", cfg.embed.tokenizer_path)
     cfg.embed.batch_window_ms = _env_float(

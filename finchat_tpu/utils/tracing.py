@@ -98,6 +98,24 @@ ANOMALY_KINDS = frozenset({
 
 TRACE_EVENT_NAMES = SPAN_MARKS | TRACE_EVENTS | ANOMALY_KINDS
 
+#: Per-row modes a ``dispatch`` event's ``args.rows`` may carry (the third
+#: element of each ``[slot, trace_id, mode]`` row) — declared so timeline
+#: consumers and tests have one source of truth.
+DISPATCH_ROW_MODES = frozenset({
+    "prefill", "prefix", "decode", "decode_loop", "spec", "constrained",
+    "ring", "freerun",
+})
+
+#: Serving quant-mode labels a ``dispatch`` event's ``args.quant`` may
+#: carry (ISSUE 14): the engine's weight mode ("bf16" = unquantized
+#: native dtype, "int8", "int4") with "+kv8" appended when the KV page
+#: pool is int8 — ``InferenceEngine.quant_label`` must stay inside this
+#: set (pinned by tests/test_quant_serving.py), so traced timelines can
+#: always distinguish bf16 from quantized dispatches.
+QUANT_MODES = frozenset({
+    "bf16", "int8", "int4", "bf16+kv8", "int8+kv8", "int4+kv8",
+})
+
 _FLIGHT_MAGIC = "FINCHAT-FLIGHT v1"
 # per-kind dump rate limit: an anomaly storm (e.g. a shed wave) records
 # every EVENT but writes at most one black box per kind per window
