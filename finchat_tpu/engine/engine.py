@@ -81,15 +81,26 @@ class DecodeState:
 
     ``k_scales``/``v_scales`` are the int8-KV-cache scale arrays
     (kv_cache.py); (1,1,1,1) placeholders when kv_quant is off so the
-    pytree structure is mode-independent."""
+    pytree structure is mode-independent.
+
+    ``kv_gaps`` is the bounded-KV compaction offset per slot (ISSUE 15;
+    kv_cache.BoundedKVPolicy): tokens the eviction policy has dropped from
+    the slot's page list, always a whole-page multiple, 0 for unbounded
+    rows. ``context_lens`` stays ABSOLUTE (it feeds rotary positions);
+    every KV write offset and attention mask runs at the COMPACTED
+    position ``absolute - kv_gaps[slot]``, so the surviving sink+window
+    pages pack the front of the page list and an evicted page simply
+    stops being referenced. All zeros reduces every compacted expression
+    to the legacy absolute one bit-for-bit."""
 
     k_pages: Array  # [L, P, page_size, Hkv*hd] (model dtype, or int8)
     v_pages: Array
     k_scales: Array  # [L, P, scale_rows, page_size] fp32 (or (1,1,1,1))
     v_scales: Array
     page_table: Array  # [max_seqs, max_pages_per_seq] int32 (0 = trash)
-    context_lens: Array  # [max_seqs] int32 — tokens whose KV is cached
+    context_lens: Array  # [max_seqs] int32 — ABSOLUTE tokens seen (rotary)
     last_tokens: Array  # [max_seqs] int32 — next decode input per slot
+    kv_gaps: Array  # [max_seqs] int32 — evicted tokens (bounded KV; 0 = none)
     rng: Array
 
 
@@ -108,6 +119,7 @@ def create_state(
         page_table=jnp.zeros((engine_cfg.max_seqs, max_pages_per_seq), jnp.int32),
         context_lens=jnp.zeros((engine_cfg.max_seqs,), jnp.int32),
         last_tokens=jnp.zeros((engine_cfg.max_seqs,), jnp.int32),
+        kv_gaps=jnp.zeros((engine_cfg.max_seqs,), jnp.int32),
         rng=jax.random.key(engine_cfg.max_seqs),
     )
 
@@ -200,11 +212,16 @@ def prefill_step(
     """Run one prefill chunk for N sequences; returns (state,
     last-valid-token logits [N, vocab])."""
     N, C = tokens.shape
-    positions = start_pos[:, None] + jnp.arange(C)[None, :]  # [N, C]
+    positions = start_pos[:, None] + jnp.arange(C)[None, :]  # [N, C] — rotary
     page_rows = state.page_table[slots]  # [N, max_pages]
 
+    # KV writes and masking run COMPACTED (bounded KV, ISSUE 15): a row
+    # whose policy evicted kv_gaps[slot] tokens writes this chunk
+    # kv_gaps[slot] positions earlier in its (compacted) page list, while
+    # the rotary positions above stay absolute. Zero gaps = identity.
     attention = _paged_attention_fn(
-        page_rows, start_pos, n_valid, page_size, config.n_kv_heads, attn_backend
+        page_rows, start_pos - state.kv_gaps[slots], n_valid,
+        page_size, config.n_kv_heads, attn_backend
     )
     # hidden states only, then project just each sequence's last valid row:
     # full-chunk fp32 logits would be [N, C, vocab] — 4.2 GB for the 8B
@@ -492,11 +509,13 @@ def decode_step(
     (agent/constrained.py), which overrides ``last_tokens`` afterwards.
     """
     tokens = state.last_tokens[:, None]  # [B, 1]
-    positions = state.context_lens[:, None]  # [B, 1]
+    positions = state.context_lens[:, None]  # [B, 1] — absolute (rotary)
     n_valid = active.astype(jnp.int32)  # [B]
 
+    # write + mask at the compacted position (bounded KV; zero-gap rows
+    # reduce to the legacy absolute math bit-for-bit)
     attention = _paged_attention_fn(
-        state.page_table, state.context_lens, n_valid,
+        state.page_table, state.context_lens - state.kv_gaps, n_valid,
         page_size, config.n_kv_heads, attn_backend,
     )
     logits, (k_pages, v_pages, k_scales, v_scales) = forward(
@@ -531,6 +550,7 @@ def _ragged_attention_fn(
     page_size: int,
     n_kv: int,
     attn_backend: str,
+    row_gap: Array | None = None,  # [R] int32 — bounded-KV eviction gap
 ):
     """Attention callback for the packed ragged step (``ragged_mixed_step``):
     per-token KV writes through the chunk scatter (one full-cache copy per
@@ -538,7 +558,12 @@ def _ragged_attention_fn(
     paged kernel (ops/ragged_paged_attention.py) reads each row's pages in
     place. The ``jax.lax`` reference backend computes each packed token as
     its own batch element of the SAME ``gather_kv`` + ``mha_reference`` math
-    the split path uses — the fp32 byte-identity contract's foundation."""
+    the split path uses — the fp32 byte-identity contract's foundation.
+
+    ``row_gap`` (bounded KV, ISSUE 15) shifts each row's KV WRITE to its
+    compacted position and rides into the kernel as the per-row
+    ``kv_gap`` offset, so the gather walks the surviving pages while
+    ``tok_pos`` — and the rotary positions upstream — stay absolute."""
     from finchat_tpu.ops.dispatch import ragged_paged_attention
 
     R = page_rows.shape[0]
@@ -547,6 +572,13 @@ def _ragged_attention_fn(
     # page (n_valid 0 redirects them inside the scatter)
     pt_tok = page_rows[safe_row]  # [T, max_pages]
     n_valid_tok = tok_valid.astype(jnp.int32)
+    if row_gap is None:
+        tok_wpos = tok_pos
+    else:
+        # valid tokens of a gapped row always sit past the evicted region
+        # (the scheduler's eviction/restore invariant), so the uniform
+        # subtraction is exact; the clamp only guards padding tokens
+        tok_wpos = jnp.maximum(tok_pos - row_gap[safe_row], 0)
 
     def attention(q: Array, k: Array, v: Array, cache: Any, layer_idx: Array):
         from finchat_tpu.utils.tracing import named_scope
@@ -557,11 +589,11 @@ def _ragged_attention_fn(
         layer = layer_idx.reshape(1)
         with named_scope("kv_scatter_ragged"):
             # each packed token is one (B=T, C=1) scatter row at its own
-            # absolute position through its own page list
+            # COMPACTED position through its own page list
             k_pages, v_pages, k_scales, v_scales = _scatter_kv(
                 (k_pages, v_pages, k_scales, v_scales),
                 k.reshape(T, 1, n_kv, -1), v.reshape(T, 1, n_kv, -1),
-                pt_tok, tok_pos, n_valid_tok, page_size, layer_idx, n_kv,
+                pt_tok, tok_wpos, n_valid_tok, page_size, layer_idx, n_kv,
             )
         with named_scope("ragged_paged_attention"):
             out = ragged_paged_attention(
@@ -570,6 +602,7 @@ def _ragged_attention_fn(
                 backend=attn_backend,
                 k_scales=k_scales if quantized else None,
                 v_scales=v_scales if quantized else None,
+                kv_gap=row_gap,
             )
         return out[None], (k_pages, v_pages, k_scales, v_scales)
 
@@ -646,10 +679,11 @@ def _ragged_round_math(
     )
     page_rows = state.page_table[row_slot]  # [R, max_pages]
     row_kv_len = jnp.where(row_len > 0, eff_start + row_len, 0)  # [R]
+    row_gap = state.kv_gaps[row_slot]  # [R] — bounded-KV compaction offset
 
     attention = _ragged_attention_fn(
         page_rows, tok_row, tok_pos, row_kv_len, tok_valid,
-        page_size, config.n_kv_heads, attn_backend,
+        page_size, config.n_kv_heads, attn_backend, row_gap=row_gap,
     )
     # hidden states only, then project only each row's sampling positions —
     # the [T, vocab] fp32 logits tensor would cost GBs at production shapes
@@ -725,7 +759,7 @@ def _ragged_round_math(
             n_valid = live.astype(jnp.int32)
 
             attn = _paged_attention_fn(
-                state.page_table, state.context_lens, n_valid,
+                state.page_table, state.context_lens - state.kv_gaps, n_valid,
                 page_size, config.n_kv_heads, attn_backend,
             )
             step_logits, (kp, vp, ks, vs) = forward(
@@ -997,11 +1031,12 @@ def decode_loop_step(
     def body(i, carry):
         state, live, token_block = carry
         tokens = state.last_tokens[:, None]  # [B, 1]
-        positions = state.context_lens[:, None]  # [B, 1]
+        positions = state.context_lens[:, None]  # [B, 1] — absolute (rotary)
         n_valid = live.astype(jnp.int32)  # [B]
 
+        # compacted write/mask coordinates (bounded KV; see decode_step)
         attention = _paged_attention_fn(
-            state.page_table, state.context_lens, n_valid,
+            state.page_table, state.context_lens - state.kv_gaps, n_valid,
             page_size, config.n_kv_heads, attn_backend,
         )
         logits, (k_pages, v_pages, k_scales, v_scales) = forward(
@@ -1086,11 +1121,14 @@ def verify_step(
     B, Kd = drafts.shape
     tokens = jnp.concatenate([state.last_tokens[:, None], drafts], axis=1)  # [B, K]
     K = Kd + 1
-    positions = state.context_lens[:, None] + jnp.arange(K)[None, :]
+    positions = state.context_lens[:, None] + jnp.arange(K)[None, :]  # rotary
     n_valid = jnp.where(active, 1 + n_drafts, 0)  # [B] tokens whose KV is written
 
+    # compacted write/mask coordinates (bounded KV; see decode_step) —
+    # rejected drafts' KV still lands beyond the new compacted length and
+    # is overwritten when those positions are reached for real
     attention = _paged_attention_fn(
-        state.page_table, state.context_lens, n_valid,
+        state.page_table, state.context_lens - state.kv_gaps, n_valid,
         page_size, config.n_kv_heads, attn_backend, inplace_append=True,
     )
     logits, (k_pages, v_pages, k_scales, v_scales) = forward(
@@ -1179,6 +1217,25 @@ class InferenceEngine:
             -(-engine_cfg.max_seq_len // engine_cfg.page_size),
         )
         self.mesh = mesh
+        # bounded-KV long-context serving (ISSUE 15): attention-sink +
+        # sliding-window page eviction. The policy is pure host math; the
+        # device side is the kv_gaps state leaf + compacted write/mask
+        # coordinates in every step function. None = unbounded (legacy).
+        from finchat_tpu.engine.kv_cache import BoundedKVPolicy
+
+        _bp = BoundedKVPolicy(
+            max(0, engine_cfg.kv_sink_pages),
+            max(0, engine_cfg.kv_window_pages),
+            engine_cfg.page_size,
+        )
+        if _bp.enabled:
+            _bp.validate(
+                prefill_chunk=engine_cfg.prefill_chunk,
+                max_pages_per_seq=self.max_pages_per_seq,
+                decode_loop_depth=self.decode_loop_depth,
+                spec_tokens=engine_cfg.spec_tokens,
+            )
+        self.bounded_kv = _bp if _bp.enabled else None
         # int8 KV composes with a mesh: pages shard over the fused KV-head
         # minor dim, scales over their head row dim (decode_state_shardings;
         # aligned blocks when Hkv % 8 == 0, replicated — they're ~6% of the
@@ -1272,6 +1329,21 @@ class InferenceEngine:
             self.state, context_lens=self.state.context_lens.at[idx].set(vals)
         )
 
+    def set_kv_gap_rows(self, rows: dict[int, int]) -> None:
+        """Set several slots' bounded-KV compaction gaps in ONE device
+        update (eviction waves / bounded session restores — see
+        set_page_table_rows for why batching matters). The gap is host-
+        deterministic metadata: the scheduler mirrors it on the handle and
+        updates both sides together between dispatches, so every enqueued
+        step sees a page table and gap that agree."""
+        import numpy as np
+
+        idx = jnp.asarray(np.asarray(list(rows), np.int32))
+        vals = jnp.asarray(np.asarray(list(rows.values()), np.int32))
+        self.state = dataclasses.replace(
+            self.state, kv_gaps=self.state.kv_gaps.at[idx].set(vals)
+        )
+
     def set_last_token(self, slot: int, token: int) -> None:
         """Override a slot's next decode input — used by grammar-constrained
         sampling after a host-side pick replaces the device-sampled token."""
@@ -1291,6 +1363,7 @@ class InferenceEngine:
             page_table=self.state.page_table.at[idx].set(0),
             context_lens=self.state.context_lens.at[idx].set(0),
             last_tokens=self.state.last_tokens.at[idx].set(0),
+            kv_gaps=self.state.kv_gaps.at[idx].set(0),
         )
 
     def offload_pages(self, page_ids: list[int]):

@@ -205,6 +205,115 @@ def pages_needed(n_tokens: int, page_size: int) -> int:
     return max(1, -(-n_tokens // page_size))
 
 
+@dataclass(frozen=True)
+class BoundedKVPolicy:
+    """SnapStream-style bounded-KV serving policy (ISSUE 15): the first
+    ``sink_pages`` pages of a row are PINNED (the attention sink) and a
+    sliding window of the ``window_pages`` most recent pages survives;
+    everything in between is evicted back to the page pool as the context
+    grows, so a live 100k-token session occupies at most
+    ``sink_pages + window_pages`` pages and decodes at flat per-token cost.
+
+    Eviction is pure host metadata riding the paged indirection: an evicted
+    page leaves the row's logical→physical page list (later pages shift one
+    logical slot down — physically nothing moves) and returns to the
+    allocator. The row tracks ``kv_gap`` — evicted tokens, always a whole
+    multiple of ``page_size`` — and every KV WRITE and attention MASK runs
+    in COMPACTED coordinates (``absolute - kv_gap``) while positions/rotary
+    stay ABSOLUTE (keys carry their original RoPE; relative distances to
+    surviving tokens are exact). Compacted-coordinate masking is exact for
+    the surviving set: a new token's q position always exceeds every
+    evicted position, so ``c_kv <= c_q`` iff ``abs_kv <= abs_q`` for sink
+    and window tokens alike (tests/test_bounded_kv.py pins this against the
+    unbounded oracle while the context still fits).
+
+    All methods are pure host-side integer math (no device work, no syncs)
+    — the scheduler's eviction wave calls them between dispatches, and the
+    free-run staging uses them to cap captures at eviction boundaries so a
+    captured round's gap schedule is identical to the host-stepped one.
+    """
+
+    sink_pages: int
+    window_pages: int
+    page_size: int
+
+    @property
+    def enabled(self) -> bool:
+        return self.sink_pages > 0 and self.window_pages > 0
+
+    @property
+    def budget_pages(self) -> int:
+        """Max pages a bounded row ever occupies (its whole page list)."""
+        return self.sink_pages + self.window_pages
+
+    @property
+    def sink_tokens(self) -> int:
+        return self.sink_pages * self.page_size
+
+    def validate(self, *, prefill_chunk: int, max_pages_per_seq: int,
+                 decode_loop_depth: int = 1, spec_tokens: int = 0) -> None:
+        """Feasibility at engine construction: the window must always be
+        able to make room for the next dispatch's writes by evicting full
+        post-sink pages — a chunk (prefill) or a fused/spec burst (decode)
+        plus one partial page of already-written tail must fit."""
+        if not self.enabled:
+            return
+        if self.sink_pages < 1 or self.window_pages < 1:
+            raise ValueError(
+                "bounded KV needs kv_sink_pages >= 1 and kv_window_pages >= 1 "
+                f"(got sink={self.sink_pages}, window={self.window_pages}); "
+                "set both to 0 for unbounded serving"
+            )
+        burst = max(prefill_chunk,
+                    1 + max(decode_loop_depth - 1, spec_tokens))
+        need = -(-burst // self.page_size) + 2  # burst + partial tail + slack
+        if self.window_pages < need:
+            raise ValueError(
+                f"kv_window_pages={self.window_pages} cannot hold a "
+                f"{burst}-token dispatch burst between eviction waves; "
+                f"need >= {need} pages of {self.page_size} tokens "
+                "(grow the window or shrink prefill_chunk)"
+            )
+        if self.budget_pages > max_pages_per_seq:
+            raise ValueError(
+                f"bounded budget {self.budget_pages} pages exceeds "
+                f"max_pages_per_seq={max_pages_per_seq}; grow max_seq_len "
+                "or shrink the sink/window"
+            )
+
+    def row_pages(self, n_tokens: int) -> int:
+        """Pages a bounded row needs for ``n_tokens`` of (compacted)
+        context — the unbounded requirement capped at the budget."""
+        return min(pages_needed(n_tokens, self.page_size), self.budget_pages)
+
+    def plan_eviction(self, compacted_ctx: int, incoming: int,
+                      capacity_pages: int, pinned_pages: int) -> int:
+        """How many whole post-sink pages to evict so the next dispatch's
+        ``incoming`` tokens fit the row's ``capacity_pages`` page list.
+        ``compacted_ctx`` is the row's compacted written length (absolute
+        minus kv_gap, INCLUDING tokens still in flight); ``pinned_pages``
+        is the unevictable head (``max(sink_pages, shared head pages)`` —
+        a shared-prefix head larger than the sink is pinned whole, an
+        effectively larger sink for that row). Returns 0 when everything
+        already fits. Deterministic in the written-token count alone — the
+        freerun capture-vs-host-stepped identity leans on this."""
+        need = -(-(compacted_ctx + incoming) // self.page_size)
+        e = max(0, need - capacity_pages)
+        if e == 0:
+            return 0
+        # only FULL post-sink pages are evictable (the newest, possibly
+        # partial page holds the live tail; pinned head pages never move)
+        evictable = max(0, compacted_ctx // self.page_size - pinned_pages)
+        if e > evictable:
+            raise PageAllocationError(
+                f"bounded eviction infeasible: need {e} pages, only "
+                f"{evictable} evictable (ctx={compacted_ctx}, "
+                f"incoming={incoming}, capacity={capacity_pages}, "
+                f"pinned={pinned_pages})"
+            )
+        return e
+
+
 def scatter_kv_chunk(
     k_pages: Any,  # [L, P, page_size, Hkv*hd] full-depth cache
     v_pages: Any,

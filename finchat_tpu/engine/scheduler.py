@@ -107,7 +107,11 @@ from finchat_tpu.engine.engine import InferenceEngine, commit_first_token, prefi
 
 if TYPE_CHECKING:  # engine must not import the agent layer at runtime
     from finchat_tpu.agent.constrained import TokenConstraint
-from finchat_tpu.engine.kv_cache import PageAllocator, pages_needed
+from finchat_tpu.engine.kv_cache import (
+    PageAllocationError,
+    PageAllocator,
+    pages_needed,
+)
 from finchat_tpu.engine.sampler import SamplingParams
 from finchat_tpu.utils.faults import inject
 from finchat_tpu.utils.logging import get_logger
@@ -189,6 +193,37 @@ class SequenceHandle:
     # pressure preempts the latest-deadline victim for a strictly-earlier
     # candidate.
     deadline: float | None = None
+    # bounded-KV serving (ISSUE 15; kv_cache.BoundedKVPolicy): tokens the
+    # eviction policy dropped from this row's page list — whole pages
+    # between the pinned sink and the surviving window; 0 = nothing
+    # evicted. Host-deterministic metadata mirrored into the engine's
+    # state.kv_gaps between dispatches (eviction waves update both sides
+    # together, so every enqueued step sees a table and gap that agree).
+    kv_gap: int = 0
+    # kv_ctx value at this row's most recent eviction wave (0 = never
+    # evicted): while kv_gap_pos exceeds the DELIVERED context, an
+    # undelivered in-flight token was computed under an older gap — a
+    # preempt taken inside that window recomputes it under the newer gap
+    # (the page-pressure path never does: it drains in-flight first).
+    kv_gap_pos: int = 0
+    # host mirror of the slot's device context length AFTER every
+    # DISPATCHED (not merely consumed) step — advanced at dispatch-build
+    # time by each dispatch's deterministic context advance. This is the
+    # eviction schedule's sole input: the wave runs between dispatches, so
+    # kv_ctx at a wave is exactly the next dispatch's write position, and
+    # the gap a token's dispatch sees becomes a PURE function of that
+    # position — independent of pipeline depth, free-run capture depth,
+    # or a preempt/replay boundary (the byte-identity contracts lean on
+    # this; delivered-count-plus-inflight inference is phase-dependent).
+    kv_ctx: int = 0
+    # preempt-replay restore plane for bounded rows (ISSUE 15 satellite):
+    # a host snapshot of the SURVIVING pages (sink + window, compacted,
+    # page-whole) taken at preemption, so re-admission restores
+    # byte-identical KV and re-prefills only the residual tail instead of
+    # re-prefilling tokens the policy would immediately evict. None for
+    # unbounded rows and rows that never evicted.
+    bounded_snap: tuple | None = None
+    bounded_snap_tokens: int = 0  # compacted tokens the snapshot covers
     # recompute preemptions survived (page pressure / breaker recovery) —
     # a preempted handle's prompt_ids become its full history and it
     # re-admits through the normal path
@@ -411,6 +446,20 @@ class ContinuousBatchingScheduler:
         self._trace_track = (
             f"replica-{replica_id}" if replica_id is not None else "engine"
         )
+        # bounded-KV long-context serving (ISSUE 15): the engine's
+        # sink+window policy (None = unbounded legacy). The
+        # finchat_boundedkv_* family pre-seeds per replica — gauges show
+        # the configured shape, the counters render from zero so the
+        # first eviction wave (and any recompute fallback) is visible.
+        self.bounded_kv = getattr(engine, "bounded_kv", None)
+        _bp = self.bounded_kv
+        self.metrics.set_gauge("finchat_boundedkv_sink_pages",
+                               _bp.sink_pages if _bp else 0)
+        self.metrics.set_gauge("finchat_boundedkv_window_pages",
+                               _bp.window_pages if _bp else 0)
+        self.metrics.inc("finchat_boundedkv_evicted_pages_total", 0.0)
+        self.metrics.inc("finchat_boundedkv_bounded_sessions_total", 0.0)
+        self.metrics.inc("finchat_boundedkv_recompute_fallbacks_total", 0.0)
         # quantized serving plane (ISSUE 14): the engine's quant mode as
         # one label on every dispatch trace event (timelines distinguish
         # bf16/int8/int4 dispatches), plus the finchat_quant_* family —
@@ -580,7 +629,11 @@ class ContinuousBatchingScheduler:
                 f"{self.max_queue_depth}); retry with backoff"
             )
         max_len = self.engine.max_pages_per_seq * self.engine.page_size
-        if len(prompt_ids) + sampling.max_new_tokens > max_len:
+        if (len(prompt_ids) + sampling.max_new_tokens > max_len
+                and self.bounded_kv is None):
+            # bounded-KV serving lifts this bound: the eviction policy
+            # caps page occupancy at sink+window regardless of context
+            # length, which is the whole point (ISSUE 15)
             raise ValueError(
                 f"sequence {seq_id}: prompt {len(prompt_ids)} + max_new "
                 f"{sampling.max_new_tokens} exceeds max length {max_len}"
@@ -666,7 +719,8 @@ class ContinuousBatchingScheduler:
             self.metrics.inc("finchat_partial_fallbacks_total")
             return False
         max_len = self.engine.max_pages_per_seq * self.engine.page_size
-        if len(full_ids) + handle.sampling.max_new_tokens > max_len:
+        if (len(full_ids) + handle.sampling.max_new_tokens > max_len
+                and self.bounded_kv is None):
             self.metrics.inc("finchat_partial_fallbacks_total")
             return False
         if handle.slot >= 0:
@@ -674,6 +728,8 @@ class ContinuousBatchingScheduler:
                 len(full_ids) + handle.sampling.max_new_tokens,
                 self.engine.page_size,
             )
+            if self.bounded_kv is not None:
+                total = min(total, self.bounded_kv.budget_pages)
             extra = total - len(handle.page_list)
             if extra > 0:
                 if total > self.engine.max_pages_per_seq or not self.allocator.can_allocate(extra):
@@ -711,9 +767,18 @@ class ContinuousBatchingScheduler:
         batch? The ONE routing predicate shared by _prefill_round and the
         mixed-step eligibility check, so they cannot drift. (A grafted hold
         stays chunked even if the full prompt is ring-length: both ring
-        paths assume they scheduled the prompt from position 0.)"""
+        paths assume they scheduled the prompt from position 0.)
+
+        Bounded-KV rows (ISSUE 15) NEVER ring-route: the seq-sharded
+        steps write KV at absolute positions (no ``kv_gaps`` awareness)
+        and a segment's write burst exceeds the eviction wave's chunk
+        reserve — either would corrupt a budget-sized page list. Bounded
+        long prompts ride chunked prefill instead (packed when decode
+        coexists, split rounds otherwise), whose C-token rows bound
+        activation memory the way the segment schedule did."""
         return (
-            self.engine._use_ring_prefill(len(handle.prompt_ids))
+            self.bounded_kv is None
+            and self.engine._use_ring_prefill(len(handle.prompt_ids))
             and not handle.grafted
             and (handle.prefill_pos == 0 or handle.ring_path
                  or handle.prefix_entry is not None)
@@ -799,6 +864,16 @@ class ContinuousBatchingScheduler:
         tuple ``(ids, shared_len, owner, pages, slot)``."""
         page = self.engine.page_size
         n_pages = min(len(prompt_ids) // page, self.engine.max_pages_per_seq)
+        if self.bounded_kv is not None:
+            # bounded rows reference at most the SINK-sized lead of a
+            # shared head (the admission clamp — head pages pin whole, so
+            # anything past the sink could never be referenced): pages
+            # registered beyond it would sit in the pool unread forever.
+            # The verify_boundedkv drive caught the full-length variant
+            # starving admission outright: two full prompt heads consumed
+            # 87 of 96 pool pages and the bounded rows waited on pages
+            # no one could ever free.
+            n_pages = min(n_pages, self.bounded_kv.sink_pages)
         if n_pages <= 0:
             return 0
         shared_len = n_pages * page
@@ -938,6 +1013,19 @@ class ContinuousBatchingScheduler:
         by exactly that amount."""
         return max(1, handle.sampling.max_new_tokens - handle.generated)
 
+    def _admission_pages(self, handle: SequenceHandle) -> int:
+        """KV pages an admission must cover for this handle (shared head
+        included): the COMPACTED prompt+budget requirement — a bounded
+        replay's ``kv_gap`` tokens have no pages — capped at the bounded
+        sink+window budget, where the eviction waves keep occupancy
+        (ISSUE 15; the satellite bugfix: the pre-bounded sizing allocated
+        and re-prefilled pages the policy would immediately evict)."""
+        n = len(handle.prompt_ids) + self._remaining_new(handle) - handle.kv_gap
+        total = pages_needed(n, self.engine.page_size)
+        if self.bounded_kv is not None:
+            total = min(total, self.bounded_kv.budget_pages)
+        return total
+
     def _shed_expired(self) -> None:
         """Deadline load shedding: pending requests past their deadline are
         dropped PRE-admission with a structured retryable error — admitting
@@ -995,59 +1083,116 @@ class ContinuousBatchingScheduler:
         self._prepare_pending()
         admitted: dict[int, list[int]] = {}
         ctx_rows: dict[int, int] = {}
+        gap_rows: dict[int, int] = {}
         page = self.engine.page_size
         while self.pending and self.free_slots:
             handle = self.pending[0]
-            total = pages_needed(
-                len(handle.prompt_ids) + self._remaining_new(handle), page
-            )
+            total = self._admission_pages(handle)
             if total > self.engine.max_pages_per_seq:
                 break  # head-of-line waits for pages (rejected at submit anyway)
-            # a MONOLITHIC ring prefill assumes position 0, so a prefix
-            # hit would force such a prompt onto the chunked path —
-            # trading away the activation-memory safety the ring exists
-            # for; skip matching there. SEGMENTED ring (ring_segment_
-            # tokens > 0) composes: the first segment simply starts at
-            # shared_len with the cached head folded as prefix, so long
-            # RAG prompts keep the system-head TTFT saving.
-            ring = self.engine._use_ring_prefill(len(handle.prompt_ids))
-            if ring and self.engine.ring_segment_tokens() == 0:
+            bsnap = handle.bounded_snap
+            if bsnap is not None:
+                # bounded preempt-replay (ISSUE 15 satellite): restore the
+                # SURVIVING sink+window pages byte-identically from the
+                # preemption snapshot and re-prefill only the residual
+                # tail. No prefix/session matching — the snapshot already
+                # holds the head region, and the evicted tokens between
+                # sink and window have no pages to match against.
+                ring = False
+                session_eligible = False
                 entry, shared_len = None, 0
+                s_entry, s_matched = None, 0
+                head_pages: list[int] = []
+                ref_entry = None
+                n_restore = -(-handle.bounded_snap_tokens // page)
+                resume_pos = handle.bounded_snap_tokens + handle.kv_gap
+                restore_snap = bsnap
+                resume_gap = handle.kv_gap
             else:
-                entry, shared_len = self._match_prefix(handle.prompt_ids)
-            # session tier: a per-conversation resume takes over whenever it
-            # matches deeper than the constant shared head (it contains the
-            # head as its own leading pages). Ring-eligible prompts keep the
-            # SP prefill path untouched — only the head composition above
-            # applies there.
-            s_entry, s_matched = (None, 0)
-            session_eligible = (
-                self.session_cache is not None and handle.conversation_id and not ring
-            )
-            if session_eligible:
-                if self.session_cache.get(handle.conversation_id) is None:
-                    # RAM miss falls through to the disk tier (ISSUE 7):
-                    # the record re-enters through import_session_entry
-                    # (head re-link + refcount), then match() below applies
-                    # the usual token comparison and divergence truncation
-                    self._restore_session_from_disk(handle.conversation_id)
-                s_entry, s_matched = self.session_cache.match(
-                    handle.conversation_id, handle.prompt_ids
+                # a MONOLITHIC ring prefill assumes position 0, so a prefix
+                # hit would force such a prompt onto the chunked path —
+                # trading away the activation-memory safety the ring exists
+                # for; skip matching there. SEGMENTED ring (ring_segment_
+                # tokens > 0) composes: the first segment simply starts at
+                # shared_len with the cached head folded as prefix, so long
+                # RAG prompts keep the system-head TTFT saving.
+                ring = (self.bounded_kv is None
+                        and self.engine._use_ring_prefill(len(handle.prompt_ids)))
+                if ring and self.engine.ring_segment_tokens() == 0:
+                    entry, shared_len = None, 0
+                else:
+                    entry, shared_len = self._match_prefix(handle.prompt_ids)
+                if (self.bounded_kv is not None
+                        and shared_len > self.bounded_kv.sink_tokens):
+                    # bounded rows reference at most the SINK-sized lead
+                    # of a shared head: head pages pin whole (they are
+                    # refcounted read-only references — the eviction wave
+                    # cannot free them), so a head deeper than the sink
+                    # would pin more pages than the budget can ever make
+                    # room around (the verify_boundedkv drive reproduced
+                    # exactly that: a 25-page system head under a 14-page
+                    # budget left nothing evictable). The sink IS the
+                    # bounded home of the constant head; the rest
+                    # re-prefills and evicts like any other context.
+                    shared_len = self.bounded_kv.sink_tokens
+                # session tier: a per-conversation resume takes over whenever
+                # it matches deeper than the constant shared head (it contains
+                # the head as its own leading pages). Ring-eligible prompts
+                # keep the SP prefill path untouched — only the head
+                # composition above applies there.
+                s_entry, s_matched = (None, 0)
+                session_eligible = (
+                    self.session_cache is not None and handle.conversation_id and not ring
                 )
-                if s_entry is None or s_matched <= shared_len:
-                    s_entry, s_matched = None, 0
-            if s_entry is not None:
-                # shared head pages referenced (never copied); the pages
-                # past the head restore from the host snapshot below
-                head_pages = s_entry.prefix_pages[: min(s_matched, s_entry.prefix_len) // page]
-                n_restore = s_entry.own_pages_for(s_matched, page)
-                ref_entry = s_entry.prefix_entry if head_pages else None
-                resume_pos = s_matched
-            else:
-                head_pages = entry.pages[: shared_len // page] if entry else []
-                n_restore = 0
-                ref_entry = entry
-                resume_pos = shared_len
+                if session_eligible:
+                    if self.session_cache.get(handle.conversation_id) is None:
+                        # RAM miss falls through to the disk tier (ISSUE 7):
+                        # the record re-enters through import_session_entry
+                        # (head re-link + refcount), then match() below applies
+                        # the usual token comparison and divergence truncation
+                        self._restore_session_from_disk(handle.conversation_id)
+                    s_entry, s_matched = self.session_cache.match(
+                        handle.conversation_id, handle.prompt_ids
+                    )
+                    if s_entry is None or s_matched <= shared_len:
+                        s_entry, s_matched = None, 0
+                    if s_entry is not None and self.bounded_kv is None:
+                        if s_entry.kv_gap:
+                            # a gapped entry (written under a bounded
+                            # policy, arriving here via disk restore or a
+                            # fleet import after the policy was turned
+                            # off) has no eviction machinery to live
+                            # under on this engine — cold-start instead
+                            s_entry, s_matched = None, 0
+                    elif (s_entry is not None
+                            and (s_entry.prefix_len > self.bounded_kv.sink_tokens
+                                 or pages_needed(s_matched - s_entry.kv_gap, page)
+                                 > self.bounded_kv.budget_pages)):
+                        # a resume whose head reference or restored pages
+                        # exceed the bounded budget cannot be laid out
+                        # (entries written by THIS bounded engine fit by
+                        # construction; pre-policy or unbounded-sibling
+                        # imports may not) — cold-start instead
+                        s_entry, s_matched = None, 0
+                if s_entry is not None:
+                    # shared head pages referenced (never copied); the pages
+                    # past the head restore from the host snapshot below
+                    head_pages = s_entry.prefix_pages[: min(s_matched, s_entry.prefix_len) // page]
+                    n_restore = s_entry.own_pages_for(s_matched, page)
+                    ref_entry = s_entry.prefix_entry if head_pages else None
+                    resume_pos = s_matched
+                    restore_snap = s_entry.snap
+                    # a bounded entry resumes with its sink+window intact
+                    # (ISSUE 15): the gap travels with the snapshot and the
+                    # slot picks up decode exactly where retirement left it
+                    resume_gap = s_entry.kv_gap
+                else:
+                    head_pages = entry.pages[: shared_len // page] if entry else []
+                    n_restore = 0
+                    ref_entry = entry
+                    resume_pos = shared_len
+                    restore_snap = None
+                    resume_gap = 0
             need = total - len(head_pages)
             if not self.allocator.can_allocate(need):
                 break  # head-of-line waits for pages
@@ -1058,7 +1203,7 @@ class ContinuousBatchingScheduler:
                 try:
                     inject("session.restore", seq_id=handle.seq_id)
                     with Timer(self.metrics, "finchat_session_restore_seconds"):
-                        self.engine.restore_pages(pages[:n_restore], s_entry.snap)
+                        self.engine.restore_pages(pages[:n_restore], restore_snap)
                     self.metrics.inc("finchat_session_cache_restored_tokens_total",
                                 resume_pos)
                 except Exception as e:
@@ -1068,7 +1213,19 @@ class ContinuousBatchingScheduler:
                     logger.error("session cache restore failed for %s: %s",
                                  handle.seq_id, e)
                     self.allocator.free(handle.seq_id, pages)
+                    if bsnap is not None:
+                        # bounded replay demotes to a full-history
+                        # recompute: the surviving-page bytes are gone, so
+                        # the gap resets and the whole history re-prefills
+                        # (post-window tokens may diverge — counted)
+                        handle.bounded_snap = None
+                        handle.bounded_snap_tokens = 0
+                        handle.kv_gap = 0
+                        self.metrics.inc(
+                            "finchat_boundedkv_recompute_fallbacks_total")
+                        total = self._admission_pages(handle)
                     s_entry = None  # the admission below is the prefix plan
+                    resume_gap = 0
                     head_pages = entry.pages[: shared_len // page] if entry else []
                     ref_entry = entry
                     resume_pos = shared_len
@@ -1081,6 +1238,10 @@ class ContinuousBatchingScheduler:
                         self.free_slots.append(slot)
                         break
                     pages = self.allocator.allocate(handle.seq_id, need)
+                else:
+                    if bsnap is not None:
+                        handle.bounded_snap = None
+                        handle.bounded_snap_tokens = 0
             if session_eligible:
                 # counted only for an admission that actually went through
                 # its plan — a page-starved head-of-line retry or a failed
@@ -1095,13 +1256,17 @@ class ContinuousBatchingScheduler:
             handle.page_list = admitted[slot]
             handle.shared_len = len(head_pages) * page
             handle.resumed_len = resume_pos if s_entry is not None else 0
+            handle.kv_gap = resume_gap
+            handle.kv_ctx = resume_pos
+            if resume_gap:
+                gap_rows[slot] = resume_gap
             if ref_entry is not None:
                 ref_entry.refs += 1
                 handle.prefix_entry = ref_entry
             if resume_pos:
                 ctx_rows[slot] = resume_pos
                 handle.prefill_pos = resume_pos
-                if s_entry is None:
+                if s_entry is None and bsnap is None:
                     self.metrics.inc("finchat_prefix_hits_total")
                     self.metrics.inc("finchat_prefix_tokens_saved_total", shared_len)
             handle.slot = slot
@@ -1123,6 +1288,8 @@ class ContinuousBatchingScheduler:
             self.engine.set_page_table_rows(admitted)
             if ctx_rows:
                 self.engine.set_context_lens_rows(ctx_rows)
+            if gap_rows:
+                self.engine.set_kv_gap_rows(gap_rows)
             self.metrics.set_gauge("finchat_queue_depth", len(self.pending))
 
     def _finish(self, handle: SequenceHandle, reason: str) -> None:
@@ -1186,9 +1353,13 @@ class ContinuousBatchingScheduler:
             return  # never reached decode; nothing coherent to keep
         page = self.engine.page_size
         # KV-cached tokens: prompt + generated minus the last delivered
-        # token, whose KV append belongs to the step that was never consumed
-        context = len(handle.history) - 1
-        n_tok = (context // page) * page
+        # token, whose KV append belongs to the step that was never
+        # consumed. Bounded rows (ISSUE 15) count in COMPACTED coordinates
+        # — the snapshot holds only the SURVIVING sink+window pages, and
+        # the entry records the gap so a restore resumes with them intact.
+        gap = handle.kv_gap
+        context = len(handle.history) - 1 - gap
+        n_tok = (context // page) * page  # compacted, page-whole
         if n_tok <= 0:
             return
         shared = min(handle.shared_len, n_tok)
@@ -1199,10 +1370,14 @@ class ContinuousBatchingScheduler:
         # restored from the previous entry's snapshot at admission and never
         # rewritten (the slot's writes start at resumed_len), so reuse those
         # host bytes — without this every retirement re-copies the WHOLE
-        # history D2H and the per-turn cost grows linearly again
+        # history D2H and the per-turn cost grows linearly again. Gapped
+        # rows skip the splice (the page↔token index math shifts under the
+        # gap, and a bounded snapshot is at most sink+window pages — the
+        # re-copy is O(budget), not O(history), by construction).
         prev = cache.get(handle.conversation_id)
         reuse_pages = 0
-        if (prev is not None and prev.snap is not None
+        if (gap == 0 and prev is not None and prev.snap is not None
+                and prev.kv_gap == 0
                 and prev.prefix_len == shared and handle.resumed_len > shared):
             m = min(handle.resumed_len, n_tok, prev.n_tokens)
             reuse_pages = (m - shared) // page
@@ -1223,11 +1398,20 @@ class ContinuousBatchingScheduler:
 
         entry = SessionEntry(
             conversation_id=handle.conversation_id,
-            token_ids=np.asarray(handle.history[:n_tok], np.int32),
+            # token ids cover the ABSOLUTE span [0, n_tok + gap): the
+            # evicted tokens' ids must still match the next turn's prompt
+            # for the surviving KV to be valid (match() compares them all)
+            token_ids=np.asarray(handle.history[: n_tok + gap], np.int32),
             prefix_entry=handle.prefix_entry if shared else None,
             prefix_pages=list(handle.page_list[: shared // page]),
             prefix_len=shared,
             snap=concat_snaps(prev.snap if reuse_pages else None, reuse_pages, snap_new),
+            kv_gap=gap,
+            # a gapped handle can retire on an UNBOUNDED engine (a fleet
+            # sibling adopted its preempt snapshot): record sink 0 there —
+            # nothing is salvageable without the policy's sink geometry
+            kv_sink=(self.bounded_kv.sink_tokens
+                     if gap and self.bounded_kv is not None else 0),
         )
         # reference the shared head BEFORE put(): put may drop an older
         # entry holding the same (possibly retired) head, and a momentary
@@ -1253,6 +1437,150 @@ class ContinuousBatchingScheduler:
         else:
             self._finish(handle, reason)
 
+    # --- bounded-KV serving (ISSUE 15; kv_cache.BoundedKVPolicy) --------
+    def _bounded_pinned_pages(self, handle: SequenceHandle) -> int:
+        """Unevictable leading pages of a bounded row: the attention sink,
+        widened to the whole shared-prefix head when the head is larger
+        (head pages are refcounted read-only references — dropping one
+        from this row's list without freeing it would just shrink the
+        sink below the policy, so the head pins whole: an effectively
+        larger sink for head-sharing rows)."""
+        return max(self.bounded_kv.sink_pages,
+                   handle.shared_len // self.engine.page_size)
+
+    def _bounded_evict_wave(self) -> None:  # finchat-lint: hot
+        """Page-granular eviction for bounded rows: between dispatches,
+        any row whose NEXT dispatch would not fit its page list evicts the
+        oldest post-sink page(s) — the pages leave the row's logical page
+        list (survivors shift down one logical slot; physically nothing
+        moves), return to the pool, and fresh pages extend the tail for
+        the incoming writes. ``kv_gap`` grows by a page per eviction and
+        the engine mirror (``state.kv_gaps``) updates in the same wave, so
+        every enqueued dispatch sees a table and gap that agree — device
+        stream order keeps in-flight programs reading the table they were
+        dispatched against, which is why no drain is needed.
+
+        The wave is host-deterministic: its sole inputs are each row's
+        ``kv_ctx`` (the dispatch-time context mirror — exactly the next
+        dispatch's write position, whatever the pipeline depth or capture
+        state) and fixed per-config reserve constants, so the gap a token
+        is computed under is a pure function of its position. That is
+        what makes the free-run capture's gap schedule identical to the
+        host-stepped one (captures are capped at the next eviction
+        boundary — ``_bounded_freerun_cap`` — exactly like budget stops)
+        and a preempt-replay's identical to the uninterrupted run's."""
+        bp = self.bounded_kv
+        if bp is None:
+            return
+        page = self.engine.page_size
+        chunk = self.engine.engine_cfg.prefill_chunk
+        pt_rows: dict[int, list[int]] = {}
+        gap_rows: dict[int, int] = {}
+        evicted_total = 0
+        for handle in list(self.prefilling) + list(self.decoding.values()):
+            if handle.slot < 0 or handle.finished or self._parked(handle):
+                continue
+            # the reserve is exactly what the next dispatch WRITES for
+            # this row: a prefill chunk, or ONE decode token — fused
+            # multi-token spans (decode_loop tails, spec verify blocks)
+            # are gated to never cross the eviction boundary
+            # (_bounded_span_room), so the only dispatch that ever
+            # reaches the boundary writes a single token. Reserving the
+            # full fused burst here would evict one dispatch EARLY
+            # whenever the gate demotes at the boundary — and a replay,
+            # whose residual chunk regroups those positions, would then
+            # see a different gap schedule than the uninterrupted run
+            # (the byte-identity contracts pin this).
+            prefilling = handle.prefill_pos < len(handle.prompt_ids)
+            if prefilling:
+                remaining = len(handle.prompt_ids) - handle.prefill_pos
+                incoming = min(chunk, remaining)
+            else:
+                incoming = 1
+            try:
+                e = bp.plan_eviction(
+                    handle.kv_ctx - handle.kv_gap, incoming,
+                    len(handle.page_list), self._bounded_pinned_pages(handle),
+                )
+            except PageAllocationError as err:
+                # infeasible plan = a policy/config violation for THIS row
+                # (e.g. a shared head pinning almost the whole budget);
+                # per-sequence isolation, the others keep serving
+                logger.error("bounded eviction infeasible for %s: %s",
+                             handle.seq_id, err)
+                self._evict(handle, "error", error=str(err))
+                continue
+            if not e:
+                continue
+            if handle.kv_gap == 0:
+                self.metrics.inc("finchat_boundedkv_bounded_sessions_total")
+            pin = self._bounded_pinned_pages(handle)
+            victims = handle.page_list[pin : pin + e]
+            handle.page_list = (
+                handle.page_list[:pin] + handle.page_list[pin + e :]
+            )
+            self.allocator.free(handle.seq_id, victims)
+            # keep capacity constant: fresh tail pages for the incoming
+            # writes (the LIFO free list usually hands the same physical
+            # pages straight back)
+            handle.page_list = handle.page_list + self.allocator.allocate(
+                handle.seq_id, e
+            )
+            handle.kv_gap += e * page
+            handle.kv_gap_pos = handle.kv_ctx
+            pt_rows[handle.slot] = handle.page_list
+            gap_rows[handle.slot] = handle.kv_gap
+            evicted_total += e
+        if pt_rows:
+            self.engine.set_page_table_rows(pt_rows)
+            self.engine.set_kv_gap_rows(gap_rows)
+            self.metrics.inc("finchat_boundedkv_evicted_pages_total",
+                             evicted_total)
+            if TRACER.enabled:
+                TRACER.event("boundedkv_evict", track=self._trace_track,
+                             args={"pages": evicted_total,
+                                   "slots": sorted(pt_rows)})
+
+    def _bounded_span_room(self, handle: SequenceHandle) -> int:
+        """Tokens this row may still write before its next eviction
+        boundary (``page-list capacity + kv_gap``). Fused multi-token
+        dispatches — decode_loop blocks/tails, spec verify spans — must
+        FIT this room: a span crossing the boundary would give its tail
+        tokens the pre-eviction gap, and since a preempt-replay (or a
+        capture) regroups spans on a shifted grid, the gap a given token
+        sees would stop being a pure function of its position — breaking
+        the byte-identity contracts. Unbounded rows have unlimited room
+        by construction (capacity covers prompt + max_new)."""
+        if self.bounded_kv is None:
+            return 1 << 30
+        boundary = (len(handle.page_list) * self.engine.page_size
+                    + handle.kv_gap)
+        return max(0, boundary - handle.kv_ctx)
+
+    def _bounded_freerun_cap(self) -> int:
+        """Rounds the next capture may free-run before some bounded row
+        needs an eviction wave — the capture-boundary staging of eviction
+        (like budget stops): within the cap the staged writes fit every
+        row's current page list, so the captured rounds see exactly the
+        gap schedule the host-stepped loop would."""
+        bp = self.bounded_kv
+        cap = self.freerun_rounds
+        if bp is None:
+            return cap
+        chunk = self.engine.engine_cfg.prefill_chunk
+        decode_burst = 1 + max(self.loop_depth - 1, self.spec_k)
+        for handle in list(self.prefilling) + list(self.decoding.values()):
+            if handle.slot < 0 or handle.finished or self._parked(handle):
+                continue
+            room = self._bounded_span_room(handle)
+            # a prefill row may flip to decode mid-capture; the larger of
+            # a chunk and a decode burst bounds both roles' per-round
+            # writes, so it is the conservative deterministic divisor
+            prefilling = handle.prefill_pos < len(handle.prompt_ids)
+            per_round = max(chunk, decode_burst) if prefilling else decode_burst
+            cap = min(cap, max(1, room // max(1, per_round)))
+        return cap
+
     # --- resilience plane (ISSUE 5; ROBUSTNESS.md) ----------------------
     def _preempt(self, handle: SequenceHandle, *, for_rebuild: bool = False) -> None:
         """Recompute preemption: free the victim's slot and KV pages but
@@ -1276,6 +1604,16 @@ class ContinuousBatchingScheduler:
             return
         slot = handle.slot
         if slot >= 0:
+            if self.bounded_kv is not None and handle.kv_gap:
+                # bounded rows preempt by SNAPSHOT, not recompute (the
+                # ISSUE 15 satellite bugfix): the surviving window's KV
+                # cannot be recomputed byte-identically from the token
+                # stream (window keys attended to tokens that are gone),
+                # and the old sizing re-prefilled — and re-allocated —
+                # pages the policy would immediately evict. Gather the
+                # surviving compacted pages to host BEFORE they free; the
+                # replay restores them and re-prefills only the tail.
+                self._bounded_preempt_snapshot(handle, for_rebuild)
             pages = self.allocator.owned_by(handle.seq_id)
             if pages:
                 self.allocator.free(handle.seq_id, pages)
@@ -1304,6 +1642,7 @@ class ContinuousBatchingScheduler:
             return  # already queued; nothing to preempt
         handle.prompt_ids = list(handle.history)
         handle.prefill_pos = 0
+        handle.kv_ctx = 0
         handle.page_list = []
         handle.shared_len = 0
         handle.resumed_len = 0
@@ -1322,6 +1661,47 @@ class ContinuousBatchingScheduler:
                                "for_rebuild": for_rebuild})
         self.metrics.set_gauge("finchat_queue_depth", len(self.pending))
         self._wakeup.set()
+
+    def _bounded_preempt_snapshot(self, handle: SequenceHandle,
+                                  for_rebuild: bool) -> None:
+        """Snapshot a bounded row's surviving pages for its replay (see
+        ``_preempt``). ``for_rebuild`` preempts run against a possibly
+        wedged device — no snapshot is attempted; the row demotes to a
+        full-history recompute (gap reset; post-window tokens may diverge
+        from the uninterrupted stream, counted as a recompute fallback).
+
+        The snapshot covers the EXACT compacted context — the partial
+        tail page included, not just whole pages: the tail tokens' KV was
+        computed against surviving pages that may since have been evicted,
+        so RE-computing them at replay would attend a different set and
+        break the byte-identity contract. Only the last history token
+        (whose KV belongs to the never-consumed step) re-prefills.
+
+        Identity caveat: the contract assumes the preempt was taken at a
+        CONSUMED boundary (``kv_gap_pos <= len(history) - 1``) — true for
+        the page-pressure path, which drains the in-flight dispatch
+        before executing its plan. A mid-flight preempt that lands inside
+        an eviction transition (breaker/whole-round-failure paths, which
+        carry no identity contract) recomputes the pending boundary token
+        under the newer gap — a valid bounded decode, one token per
+        page-crossing wide."""
+        page = self.engine.page_size
+        snap_tokens = len(handle.history) - 1 - handle.kv_gap
+        if not for_rebuild and snap_tokens > 0:
+            try:
+                n = -(-snap_tokens // page)  # whole pages incl. partial tail
+                handle.bounded_snap = self.engine.offload_pages(
+                    handle.page_list[:n]
+                )
+                handle.bounded_snap_tokens = snap_tokens
+                return
+            except Exception as e:
+                logger.error("bounded preempt snapshot failed for %s: %s",
+                             handle.seq_id, e)
+        handle.bounded_snap = None
+        handle.bounded_snap_tokens = 0
+        handle.kv_gap = 0
+        self.metrics.inc("finchat_boundedkv_recompute_fallbacks_total")
 
     def _preemption_plan(self) -> list[SequenceHandle]:
         """Page-pressure preemption policy: when the earliest-deadline
@@ -1345,9 +1725,7 @@ class ContinuousBatchingScheduler:
         if cand.deadline is None:
             return []  # only deadline urgency justifies evicting live KV
         page = self.engine.page_size
-        total = pages_needed(
-            len(cand.prompt_ids) + self._remaining_new(cand), page
-        )
+        total = self._admission_pages(cand)
         if total > self.engine.max_pages_per_seq:
             return []
         # prefix-aware need (same plan _admit will compute): an admission
@@ -1984,6 +2362,7 @@ class ContinuousBatchingScheduler:
                                 ts=_pt.started, dur=_pt.elapsed,
                             )
                         handle.prefill_pos = len(handle.prompt_ids)
+                        handle.kv_ctx = handle.prefill_pos
                         completions.append((handle, ring_logits, handle.epoch))
                         continue
                     # chunked ring: ONE segment per round — decode steps
@@ -2005,6 +2384,7 @@ class ContinuousBatchingScheduler:
                             ts=_pt.started, dur=_pt.elapsed,
                         )
                     handle.prefill_pos += len(seg)
+                    handle.kv_ctx = handle.prefill_pos
                     if handle.prefill_pos >= len(handle.prompt_ids):
                         completions.append((handle, seg_logits, handle.epoch))
                     continue
@@ -2042,6 +2422,7 @@ class ContinuousBatchingScheduler:
                                      ts=_pt.started, dur=_pt.elapsed)
             for i, handle in enumerate(batch):
                 handle.prefill_pos += int(n_valids[i])
+                handle.kv_ctx = handle.prefill_pos
                 if handle.prefill_pos >= len(handle.prompt_ids):
                     if handle.held:
                         continue  # park: the first token commits only
@@ -2146,7 +2527,7 @@ class ContinuousBatchingScheduler:
     # every reason a free-run capture caps to one host-stepped round —
     # pre-seeded at 0 when the free-running loop is enabled (the same
     # discipline as MIXED_DEMOTION_REASONS)
-    FREERUN_CAP_REASONS = ("constrained", "spec", "underfill")
+    FREERUN_CAP_REASONS = ("constrained", "spec", "underfill", "boundedkv")
 
     def _freerun_rounds_cap(self) -> int:
         """How many consecutive rounds the next capture may free-run — the
@@ -2155,8 +2536,10 @@ class ContinuousBatchingScheduler:
         host-stepped behavior): grammar-constrained rows (the host pick
         feeds the next round's input) and live spec-proposal windows
         (drafts are proposed from DELIVERED tokens the device is still
-        holding). Ring-routed rows already demote the whole mixed path
-        (``_use_mixed``), so they never reach here."""
+        holding). Bounded-KV rows cap the capture at their next eviction
+        boundary (ISSUE 15 — eviction is staged at capture boundaries
+        like budget stops, so a capture's gap schedule matches the
+        host-stepped loop's exactly)."""
         F = self.freerun_rounds
         if F <= 1:
             return 1
@@ -2173,6 +2556,12 @@ class ContinuousBatchingScheduler:
             self.metrics.inc("finchat_freerun_capped_total",
                              labels={"reason": "spec"})
             return 1
+        if self.bounded_kv is not None:
+            cap = self._bounded_freerun_cap()
+            if cap < F:
+                self.metrics.inc("finchat_freerun_capped_total",
+                                 labels={"reason": "boundedkv"})
+                return max(1, cap)
         return F
 
     def _dispatch_freerun(self, rounds: int,  # finchat-lint: hot
@@ -2291,16 +2680,25 @@ class ContinuousBatchingScheduler:
                                  ts=_mt.started, dur=_mt.elapsed)
         # prompt-cursor bookkeeping at dispatch, exactly _ragged_round's
         # discipline: the staged chunks ARE dispatched
-        for row, _slot, owner, _epoch, kind in members:
+        for row, slot, owner, _epoch, kind in members:
             adv = plan.advanced.get(row, 0)
-            if not adv:
+            if kind == "job":
+                if adv:
+                    owner.pos += adv
+                    if owner.pos >= owner.shared_len:
+                        self._complete_prefix_job(owner, "freerun")
                 continue
-            if kind == "prefill":
+            if adv:
                 owner.prefill_pos += adv
-            elif kind == "job":
-                owner.pos += adv
-                if owner.pos >= owner.shared_len:
-                    self._complete_prefix_job(owner, "freerun")
+                owner.kv_ctx = owner.prefill_pos
+            # staged decode rounds advance device context by 1 per armed
+            # round (+ the fused tails) — plan.ahead counts exactly those
+            # emissions, except a completion flip's first token (sampled,
+            # its KV not yet written)
+            extra = plan.ahead.get(slot, 0)
+            if row in plan.completes_at:
+                extra -= 1
+            owner.kv_ctx += max(0, extra)
         return _InFlightRing(
             tokens=ring_tok, n_emitted=ring_n, blocks=ring_blk,
             rounds=rounds, members=members, armed=plan.row_arm,
@@ -2393,23 +2791,26 @@ class ContinuousBatchingScheduler:
     def _use_mixed(self) -> bool:
         """Can this iteration run ONE packed ragged dispatch instead of a
         prefill round plus a decode-side dispatch? Both populations must
-        exist. Since the ragged rebuild (ISSUE 10) spec-decode verify
-        blocks, decode_loop fused tails, and grammar-constrained picks all
-        ride the SAME dispatch as rows of the packed buffer — the old
-        demotion list (PR 4) is erased down to ring/seq-sharded prefill
-        rows, whose collective schedule cannot ride a single-chip packed
-        step. Each demoted coexist-iteration is counted per reason in
-        ``finchat_mixed_demotions_total{reason=...}`` (spec/decode_loop/
-        constrained are pre-seeded at zero — the erasure is observable).
-        The split path stays the golden-identical fallback either way."""
+        exist — and that is now the ONLY condition. The ragged rebuild
+        (ISSUE 10) folded spec verify blocks, decode_loop fused tails, and
+        grammar-constrained picks into rows of the packed buffer; ring/
+        seq-sharded prefill — the last demotion reason — is promoted too
+        (ISSUE 15): a ring-routed prompt rides the packed round as
+        ordinary bounded-size chunk rows, where the ragged kernel's
+        per-page online-softmax accumulation IS the ring fold's carry
+        (ops/ring_attention.py ``ring_attention_with_prefix`` — each chunk
+        folds the cached earlier segments page by page), and a
+        prefill_chunk-sized row bounds activation memory the way the
+        segmented ring schedule did. ``finchat_mixed_demotions_total``
+        stays pre-seeded per reason — INCLUDING reason="ring" — so the
+        complete erasure is observable (bench --ragged-sweep /
+        --longctx-smoke gate it at zero). The split path — where
+        ring-routed rows still run their seq-sharded collective schedule
+        when no decode coexists — stays the golden-identical fallback."""
         if not self.mixed_enabled or not self.decoding:
             return False
         rows = [h for h in self.prefilling if not self._parked(h)]
         if not rows and not self._prefix_jobs:
-            return False
-        if any(self._ring_routed(h) for h in rows):
-            self.metrics.inc("finchat_mixed_demotions_total",
-                             labels={"reason": "ring"})
             return False
         return True
 
@@ -2532,6 +2933,7 @@ class ContinuousBatchingScheduler:
                 tok_row.append(i)
                 constrained_rows.append(i)
                 constrained_decode.append((i, slot, h, epoch))
+                h.kv_ctx += 1
                 i += 1
                 continue
             prop: list[int] = []
@@ -2540,7 +2942,10 @@ class ContinuousBatchingScheduler:
                 if h.ngram_index is None:  # one-time build; _deliver
                     h.ngram_index = NgramIndex(h.history)  # keeps it in sync
                 remaining = h.sampling.max_new_tokens - h.generated
-                prop = h.ngram_index.propose(min(Kd, remaining - 1))
+                # bounded rows: the (1 + drafts) verify span must fit the
+                # eviction-boundary room (see _bounded_span_room)
+                cap = min(Kd, remaining - 1, self._bounded_span_room(h) - 1)
+                prop = h.ngram_index.propose(cap) if cap > 0 else []
             s = h.sampling
             temp[i], top_p[i], top_k[i] = s.temperature, s.top_p, s.top_k
             if prop:
@@ -2553,6 +2958,9 @@ class ContinuousBatchingScheduler:
                 packed += [int(t) for t in prop]
                 tok_row += [i] * len(prop)
                 spec_rows.append((i, slot, h, epoch))
+                # context advances by n_emitted (>= 1) — the extra
+                # accepted tokens land on kv_ctx at consume (depth-1)
+                h.kv_ctx += 1
             else:
                 row_len[i] = 1
                 packed.append(0)
@@ -2561,9 +2969,14 @@ class ContinuousBatchingScheduler:
                 if self.loop_depth > 1 and self._loop_eligible(h, 0):
                     # fused K-token tail inside the SAME dispatch: the
                     # row's phase-1 token plus loop_depth-1 tail tokens
-                    # stay within the budget _loop_eligible checks
+                    # stay within the budget (and eviction-boundary room)
+                    # _loop_eligible checks — the span starts at the
+                    # phase-1 write, so eligibility runs pre-bump
                     loop_active[slot] = True
                     loop_members.append((slot, h, epoch))
+                    h.kv_ctx += self.loop_depth
+                else:
+                    h.kv_ctx += 1
             i += 1
 
         T = eng.ragged_bucket(len(packed))
@@ -2602,6 +3015,7 @@ class ContinuousBatchingScheduler:
         # prefill bookkeeping happens at dispatch: row_len is host data
         for idx, h in prefill_rows:
             h.prefill_pos += int(row_len[idx])
+            h.kv_ctx = h.prefill_pos
         for idx, job in job_rows:
             job.pos += int(row_len[idx])
             if job.pos >= job.shared_len:
@@ -2654,6 +3068,7 @@ class ContinuousBatchingScheduler:
             if handle.finished or handle.slot != slot or handle.epoch != epoch:
                 continue
             n = int(n_emitted[idx])
+            handle.kv_ctx += max(0, n - 1)  # accepted drafts' context advance
             accepted_total += max(0, n - 1)
             for token in emitted[idx, :n]:
                 self._deliver(handle, int(token))
@@ -2750,6 +3165,7 @@ class ContinuousBatchingScheduler:
                 continue
             active[slot] = True
             members.append((slot, handle, epoch))
+            handle.kv_ctx += 1
         # step logits come back to host only while a grammar-constrained
         # sequence is IN this step (a second compiled decode variant), and
         # only the constrained rows are transferred — a [n, vocab] device
@@ -2818,6 +3234,10 @@ class ContinuousBatchingScheduler:
             handle.constraint is None
             and handle.sampling.max_new_tokens - handle.generated - ahead
             >= self.loop_depth
+            # bounded rows: the fused span must not cross the next
+            # eviction boundary (see _bounded_span_room) — the row rides
+            # single-step for that iteration and rejoins after the wave
+            and self._bounded_span_room(handle) >= self.loop_depth
         )
 
     def _dispatch_decode_loop(
@@ -2858,6 +3278,7 @@ class ContinuousBatchingScheduler:
             if self._loop_eligible(handle, ahead.get(slot, 0)):
                 active[slot] = True
                 block_members.append((slot, handle, epoch))
+                handle.kv_ctx += self.loop_depth
             else:
                 demoted.append((slot, handle, epoch))
         token_block = eng.decode_loop(
@@ -2989,17 +3410,26 @@ class ContinuousBatchingScheduler:
                 if handle.ngram_index is None:  # one-time build; _deliver
                     handle.ngram_index = NgramIndex(handle.history)  # keeps it in sync
                 remaining = handle.sampling.max_new_tokens - handle.generated
-                prop = handle.ngram_index.propose(min(Kd, remaining - 1))
+                # bounded rows: the verify span must fit the
+                # eviction-boundary room (see _bounded_span_room) —
+                # computed BEFORE the kv_ctx bump, at the span's start
+                cap = min(Kd, remaining - 1,
+                          self._bounded_span_room(handle) - 1)
+                prop = handle.ngram_index.propose(cap) if cap > 0 else []
                 drafts[slot, : len(prop)] = prop
                 n_drafts[slot] = len(prop)
         if not n_drafts.any():
             # every candidate missed its n-gram lookup this step: a
             # Kd+1-wide verify forward would cost K× the query compute for
             # an unconditional n_emitted == 1 — run the plain (cheaper,
-            # already-warmed) decode step instead
+            # already-warmed) decode step instead (which does its own
+            # kv_ctx accounting — bumping here too would double-count
+            # this step and skew the eviction schedule off its positions)
             self._spec_note_step(accepted=0)
             await self._consume_step(self._dispatch_decode())
             return
+        for _slot, handle, _epoch in members:
+            handle.kv_ctx += 1  # the verify's position-0 write
 
         constrained_slots = sorted(
             slot for slot, h, _e in members if h.constraint is not None
@@ -3041,6 +3471,7 @@ class ContinuousBatchingScheduler:
                 self._deliver(handle, token)
                 continue
             n = int(n_emitted_host[slot])
+            handle.kv_ctx += max(0, n - 1)  # accepted drafts' context advance
             accepted_total += max(0, n - 1)
             for token in emitted_host[slot, :n]:
                 self._deliver(handle, int(token))
@@ -3168,6 +3599,13 @@ class ContinuousBatchingScheduler:
                         )
                         self._preempt(victim)
                 self._admit()
+                # bounded-KV eviction wave (ISSUE 15): runs BETWEEN
+                # dispatches — the page-table/gap updates enqueue after
+                # every in-flight program and before this iteration's
+                # dispatch, so device stream order keeps each program
+                # reading the table it was staged against; the freed
+                # pages' next writers are ordered after it too
+                self._bounded_evict_wave()
             except Exception as e:
                 # admission must never kill the loop (e.g. device state
                 # mid-rebuild-failure): log, back off, keep serving what
@@ -3270,6 +3708,16 @@ class ContinuousBatchingScheduler:
                 except Exception as e:
                     logger.error("prefill round error: %s", e)
                     await self._round_failed("prefill", str(e))
+                try:
+                    # a completion flips straight into THIS iteration's
+                    # decode dispatch below: its first decode write needs
+                    # the wave's capacity guarantee at the advanced
+                    # kv_ctx — without it, a completion landing exactly on
+                    # a full page list would trash-write its first decode
+                    # KV. Idempotent; no-op when no boundary was crossed.
+                    self._bounded_evict_wave()
+                except Exception as e:
+                    logger.error("bounded eviction wave error: %s", e)
 
             if (
                 self.decoding and self.spec_k > 0

@@ -288,7 +288,8 @@ class SessionDiskTier:
     # --- record (de)serialization ---------------------------------------
     @staticmethod
     def _serialize(key: str, token_ids: np.ndarray, prefix_len: int,
-                   snap: tuple | None) -> bytes:
+                   snap: tuple | None, kv_gap: int = 0,
+                   kv_sink: int = 0) -> bytes:
         token_ids = np.ascontiguousarray(token_ids, np.int32)
         chunks = [token_ids.tobytes()]
         specs: list[dict | None] | None = None
@@ -308,6 +309,12 @@ class SessionDiskTier:
             "n_tokens": int(token_ids.shape[0]),
             "snap": specs,
             "kv": snap_kv_mode(snap),
+            # bounded-KV entries (ISSUE 15): evicted-token gap between the
+            # pinned sink and the surviving window, and the absolute sink
+            # end it inserts at. Additive v2 fields — records without
+            # them (pre-ISSUE-15, and all v1) read as 0
+            "kv_gap": int(kv_gap),
+            "kv_sink": int(kv_sink),
             "payload_len": len(payload),
             "crc": zlib.crc32(payload),
         }).encode()
@@ -368,11 +375,13 @@ class SessionDiskTier:
             "token_ids": token_ids,
             "prefix_len": int(header["prefix_len"]),
             "snap": snap,
+            "kv_gap": int(header.get("kv_gap", 0)),
+            "kv_sink": int(header.get("kv_sink", 0)),
         }
 
     # --- write path ------------------------------------------------------
     def spill(self, key: str, token_ids: np.ndarray, prefix_len: int,
-              snap: tuple | None) -> bool:
+              snap: tuple | None, kv_gap: int = 0, kv_sink: int = 0) -> bool:
         """Record one entry (atomic write-rename), then LRU-evict records
         past the byte budget. Write-behind: the serialize + fsync runs on
         the writer thread and this returns immediately (True = accepted);
@@ -383,9 +392,10 @@ class SessionDiskTier:
             with self._lock:
                 self._pending[key] = self._pending.get(key, 0) + 1
             self._writer.submit(self._write_record, key, token_ids,
-                                prefix_len, snap)
+                                prefix_len, snap, kv_gap, kv_sink)
             return True
-        return self._write_record(key, token_ids, prefix_len, snap)
+        return self._write_record(key, token_ids, prefix_len, snap, kv_gap,
+                                  kv_sink)
 
     def _unpend(self, key: str) -> None:
         """One queued write for ``key`` finished (landed or failed)."""
@@ -399,14 +409,16 @@ class SessionDiskTier:
                 self._pending[key] = n
 
     def _write_record(self, key: str, token_ids: np.ndarray, prefix_len: int,
-                      snap: tuple | None) -> bool:
+                      snap: tuple | None, kv_gap: int = 0,
+                      kv_sink: int = 0) -> bool:
         """Writer-thread body (inline when ``async_writes`` is off)."""
         fname = self._fname(key)
         final = self.path / fname
         tmp = self.path / (fname + ".tmp")
         try:
             inject("disk.spill", key=key)
-            blob = self._serialize(key, token_ids, prefix_len, snap)
+            blob = self._serialize(key, token_ids, prefix_len, snap, kv_gap,
+                                   kv_sink)
             with open(tmp, "wb") as f:
                 f.write(blob)
                 f.flush()
@@ -630,6 +642,18 @@ class SessionEntry:
     host snapshot. ``prefix_entry`` (a scheduler ``_PrefixEntry`` or None)
     carries one reference held for the entry's lifetime; the cache's
     ``on_drop`` callback is where the scheduler releases it.
+
+    ``kv_gap`` (bounded-KV serving, ISSUE 15): tokens the eviction policy
+    dropped between the pinned sink (``kv_sink`` absolute tokens) and the
+    surviving window when the sequence retired. The snapshot then covers
+    only the SURVIVING pages — ``n_tokens - kv_gap - prefix_len`` tokens —
+    while ``token_ids`` still spans the full absolute range (the evicted
+    tokens' ids must match the next turn's prompt for the surviving KV to
+    be valid). A gapped entry resumes whole (sink+window intact) when the
+    prompt extends past its span unchanged; on divergence the windowed
+    remainder is unusable (it attended to the now-mismatched history) and
+    ``match`` salvages at most the pre-gap sink region as an ordinary
+    gap-free prefix.
     """
 
     conversation_id: str
@@ -638,6 +662,12 @@ class SessionEntry:
     prefix_pages: list[int] = field(default_factory=list)  # device page ids, referenced
     prefix_len: int = 0  # tokens covered by prefix_pages (page multiple)
     snap: tuple | None = None  # host page arrays covering [prefix_len, n_tokens)
+    kv_gap: int = 0  # bounded-KV evicted tokens (page multiple; 0 = exact)
+    # absolute position the gap inserts at (the sink end; page multiple):
+    # tokens below it attended only EARLIER sink tokens, so they remain a
+    # valid ordinary prefix even when the windowed remainder is stale —
+    # the divergence salvage in match() leans on this. 0 when kv_gap is 0.
+    kv_sink: int = 0
 
     @property
     def n_tokens(self) -> int:
@@ -648,8 +678,9 @@ class SessionEntry:
         return _snap_nbytes(self.snap)
 
     def own_pages_for(self, matched: int, page_size: int) -> int:
-        """How many snapshot pages a ``matched``-token resume restores."""
-        return max(0, matched - self.prefix_len) // page_size
+        """How many snapshot pages a ``matched``-token resume restores
+        (the evicted gap has no pages)."""
+        return max(0, matched - self.prefix_len - self.kv_gap) // page_size
 
 
 class SessionKVCache:
@@ -779,7 +810,8 @@ class SessionKVCache:
         if self.disk is None or entry.n_tokens == 0:
             return False
         return self.disk.spill(
-            entry.conversation_id, entry.token_ids, entry.prefix_len, entry.snap
+            entry.conversation_id, entry.token_ids, entry.prefix_len,
+            entry.snap, entry.kv_gap, entry.kv_sink,
         )
 
     def spill_all(self) -> int:
@@ -807,6 +839,12 @@ class SessionKVCache:
         nbytes = _snap_nbytes(snap)
         if nbytes <= self.budget_bytes:
             return payload
+        if payload.get("kv_gap"):
+            # a bounded entry is whole-or-not (see SessionEntry): trimming
+            # would cut the window the gap semantics depend on. Bounded
+            # snapshots are at most sink+window pages, so one exceeding
+            # the RAM budget is a configuration problem, not a hot path.
+            return None
         prefix_len = int(payload["prefix_len"])
         own_pages = (len(payload["token_ids"]) - prefix_len) // self.page_size
         keep = int(own_pages * self.budget_bytes // nbytes)
@@ -845,6 +883,8 @@ class SessionKVCache:
             "token_ids": np.array(entry.token_ids, copy=True),
             "prefix_len": int(entry.prefix_len),
             "snap": entry.snap,
+            "kv_gap": int(entry.kv_gap),
+            "kv_sink": int(entry.kv_sink),
         }
 
     def import_entry(self, payload: dict, *, prefix_entry: Any | None = None,
@@ -865,6 +905,8 @@ class SessionKVCache:
             prefix_pages=list(prefix_pages or []),
             prefix_len=prefix_len,
             snap=payload["snap"],
+            kv_gap=int(payload.get("kv_gap", 0)),
+            kv_sink=int(payload.get("kv_sink", 0)),
         )
         return self.put(entry, spill=spill)
 
@@ -888,6 +930,34 @@ class SessionKVCache:
         n = min(entry.n_tokens, len(prompt))
         neq = np.nonzero(entry.token_ids[:n] != prompt[:n])[0]
         common = int(neq[0]) if neq.size else n
+        if entry.kv_gap:
+            # bounded entries (ISSUE 15) resume WHOLE when the prompt
+            # extends past their span unchanged (sink+window intact)...
+            if not neq.size:
+                if common >= entry.n_tokens and len(prompt) - 1 >= entry.n_tokens:
+                    self._entries.move_to_end(conversation_id)
+                    return entry, entry.n_tokens
+                # a prompt that merely STOPS SHORT (no divergence) can't
+                # use the entry but hasn't staled it — keep it intact for
+                # the turn that extends past the span
+                return None, 0
+            # ...and on DIVERGENCE salvage only the pre-gap sink region:
+            # the windowed remainder attended to the evicted tokens, so a
+            # mismatch anywhere below it stales it beyond repair — but
+            # sink tokens attended only earlier sink tokens, so they
+            # truncate into a perfectly ordinary gap-free prefix entry
+            # (the RAG workload diverges every turn where the previous
+            # turn's retrieved block sat; without the salvage a bounded
+            # conversation would never resume warm).
+            salvage = (min(common, entry.kv_sink) // page) * page
+            entry.kv_gap = 0
+            entry.kv_sink = 0
+            self._truncate(entry, min(salvage, entry.n_tokens))
+            if entry.n_tokens == 0:
+                return None, 0
+            # the salvaged entry continues through the ordinary gap-free
+            # matching below; the original common may overshoot it
+            common = min(common, entry.n_tokens)
         if common < entry.n_tokens:
             self._truncate(entry, (common // page) * page)
             if entry.n_tokens == 0:

@@ -106,11 +106,15 @@ def ragged_paged_attention(
     backend: str | None = None,
     k_scales: Array | None = None,  # int8 cache: [L, P, SPAD, page_size] fp32
     v_scales: Array | None = None,
+    kv_gap: Array | None = None,  # [R] — bounded-KV window offset per row
 ) -> Array:
     """Ragged paged-KV attention (ops/ragged_paged_attention.py): prefill
     chunks, decode tokens, and spec verify blocks as rows of ONE packed
     buffer. An int8 cache (engine kv_quant) is detected from the page
-    dtype; the scale arrays must then be provided."""
+    dtype; the scale arrays must then be provided. ``kv_gap`` is the
+    bounded-KV per-row eviction offset (tokens dropped between the pinned
+    sink pages and the surviving window — see
+    ragged_paged_attention_ref); None/zeros = exact unbounded attention."""
     backend = backend or attention_backend()
     quantized = k_pages.dtype == jnp.int8
     if quantized:
@@ -125,6 +129,7 @@ def ragged_paged_attention(
             page_size=page_size, n_kv=n_kv,
             k_scales=k_scales if quantized else None,
             v_scales=v_scales if quantized else None,
+            kv_gap=kv_gap,
         )
     interpret = backend == "pallas-interpret"
     if quantized:
@@ -136,12 +141,14 @@ def ragged_paged_attention(
             q, k_pages, v_pages, k_scales, v_scales, page_table,
             tok_row, tok_pos, kv_len, layer,
             page_size=page_size, n_kv=n_kv, interpret=interpret,
+            kv_gap=kv_gap,
         )
     from finchat_tpu.ops.ragged_paged_attention import ragged_flash_attention
 
     return ragged_flash_attention(
         q, k_pages, v_pages, page_table, tok_row, tok_pos, kv_len, layer,
         page_size=page_size, n_kv=n_kv, interpret=interpret,
+        kv_gap=kv_gap,
     )
 
 

@@ -47,6 +47,14 @@ CPU/tier-1 oracle and the serving path on non-TPU backends):
 Cache layout and the full-depth ``layer`` scalar-prefetch contract are
 identical to ops/paged_attention.py (the cache rides the model's layer scan
 as a carry).
+
+Bounded-KV serving (ISSUE 15, SnapStream-style sink+window): the per-row
+page indirection is exactly what makes page-granular eviction free — an
+evicted page just leaves the row's page list and the survivors pack the
+front. The wrappers accept a per-row ``kv_gap`` (evicted-token count, the
+``kv_window_start`` offset) and shift masking into compacted coordinates
+(:func:`_compact_window`) while positions/rotary stay absolute upstream;
+the kernel bodies are gap-oblivious.
 """
 
 from __future__ import annotations
@@ -64,6 +72,32 @@ from finchat_tpu.ops.flash_attention import NEG_INF, _online_softmax_update, _ro
 TRASH_PAGE = 0
 
 
+def _compact_window(tok_row, tok_pos, kv_len, kv_gap, R: int):
+    """Bounded-KV coordinate shift (SnapStream sink+window serving —
+    ISSUE 15): ``kv_gap[r]`` tokens of row ``r`` were evicted between the
+    pinned sink pages and the surviving window, and the row's page table
+    already walks only the SURVIVORS (an evicted page just left the list).
+    Masking and page-bound math therefore run in COMPACTED coordinates —
+    query positions and kv lengths shift down by the row's gap — while the
+    caller's rotary positions stay absolute (keys keep their original RoPE,
+    so relative distances to surviving tokens are exact).
+
+    Compacted-coordinate causality is exact for the surviving set: every
+    live query sits past the whole evicted region, so ``c_kv <= c_q`` iff
+    ``abs_kv <= abs_q`` for sink tokens (unshifted, below the gap) and
+    window tokens (shifted by the same gap) alike. ``kv_gap=None`` (or all
+    zeros) is the identity — the unbounded paths are bit-unchanged."""
+    if kv_gap is None:
+        return tok_pos, kv_len
+    gap = jnp.asarray(kv_gap, jnp.int32)
+    safe = jnp.minimum(jnp.asarray(tok_row, jnp.int32), R - 1)
+    # the clamp guards padding tokens (tok_pos 0); real tokens of a gapped
+    # row always sit past the evicted region (the scheduler's invariant)
+    tok_pos = jnp.maximum(jnp.asarray(tok_pos, jnp.int32) - gap[safe], 0)
+    kv_len = jnp.maximum(jnp.asarray(kv_len, jnp.int32) - gap, 0)
+    return tok_pos, kv_len
+
+
 def ragged_paged_attention_ref(
     q: Array,  # [T, H, D] packed query tokens
     k_pages: Array,  # [L, P, page_size, Hkv*D] full-depth cache (or int8)
@@ -79,6 +113,7 @@ def ragged_paged_attention_ref(
     scale: float | None = None,
     k_scales: Array | None = None,  # int8 cache: [L, P, SPAD, page_size] fp32
     v_scales: Array | None = None,
+    kv_gap: Array | None = None,  # [R] int32 — bounded-KV window offset
 ) -> Array:
     """``jax.lax`` reference for the ragged kernel — the correctness oracle
     AND the CPU/tier-1 serving path (ops/dispatch.py backend "ref").
@@ -90,12 +125,19 @@ def ragged_paged_attention_ref(
     mixed-vs-split byte-identity gate (bench --ragged-sweep) leans on.
     Padding tokens (``tok_row == R``) read the trash row with ``kv_len 0``
     and produce zeros, exactly like an inactive decode slot.
+
+    ``kv_gap`` (bounded KV, ISSUE 15 — see :func:`_compact_window`) is the
+    per-row count of evicted tokens: the gather below already walks only
+    the surviving pages (eviction compacted the page list), so the only
+    change is the coordinate shift; None/zeros is bit-identical to the
+    unbounded path.
     """
     from finchat_tpu.engine.kv_cache import gather_kv_any
     from finchat_tpu.ops.refs import mha_reference
 
     T = q.shape[0]
     R, MP = page_table.shape
+    tok_pos, kv_len = _compact_window(tok_row, tok_pos, kv_len, kv_gap, R)
     lay = jnp.asarray(layer, jnp.int32).reshape(())
     # row R = an all-trash row with kv_len 0 (the padding-token row)
     pt_pad = jnp.concatenate(
@@ -353,10 +395,14 @@ def ragged_flash_attention(  # finchat-lint: hot
     scale: float | None = None,
     block_q: int = 8,
     interpret: bool | None = None,
+    kv_gap: Array | None = None,  # [R] int32 — bounded-KV window offset
 ) -> Array:
     """Ragged paged attention over the native-dtype cache; returns
     [T, H, D]. Same descriptor contract as ``ragged_paged_attention_ref``
-    (the oracle tests pin them against each other)."""
+    (the oracle tests pin them against each other). ``kv_gap`` shifts a
+    bounded row into compacted coordinates at the wrapper level
+    (:func:`_compact_window`) — the kernel body is gap-oblivious: its
+    page-bound and causal masks simply run on the compacted inputs."""
     T, H, D = q.shape
     R, max_pages = page_table.shape
     assert H % n_kv == 0, (H, n_kv)
@@ -366,6 +412,7 @@ def ragged_flash_attention(  # finchat-lint: hot
     scale = scale if scale is not None else D ** -0.5
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    tok_pos, kv_len = _compact_window(tok_row, tok_pos, kv_len, kv_gap, R)
 
     layer = jnp.asarray(layer, jnp.int32)
     pt_pad = jnp.concatenate(
@@ -445,10 +492,12 @@ def ragged_flash_attention_q8(  # finchat-lint: hot
     scale: float | None = None,
     block_q: int = 8,
     interpret: bool | None = None,
+    kv_gap: Array | None = None,  # [R] int32 — bounded-KV window offset
 ) -> Array:
     """Int8-KV ragged paged attention; same contract as
     ``ragged_flash_attention`` with the scale arrays riding the same
-    scalar-prefetched page indirection."""
+    scalar-prefetched page indirection (and the same wrapper-level
+    bounded-KV coordinate shift)."""
     T, H, D = q.shape
     R, max_pages = page_table.shape
     assert H % n_kv == 0, (H, n_kv)
@@ -459,6 +508,7 @@ def ragged_flash_attention_q8(  # finchat-lint: hot
     scale = scale if scale is not None else D ** -0.5
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    tok_pos, kv_len = _compact_window(tok_row, tok_pos, kv_len, kv_gap, R)
     spad = k_scales.shape[2]
 
     layer = jnp.asarray(layer, jnp.int32)

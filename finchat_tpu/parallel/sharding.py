@@ -115,6 +115,7 @@ def decode_state_shardings(mesh: Mesh, n_kv_heads: int | None = None) -> dict[st
         "page_table": ns(None, None),
         "context_lens": ns(None),
         "last_tokens": ns(None),
+        "kv_gaps": ns(None),
         "rng": ns(),
     }
 

@@ -362,6 +362,24 @@ class EngineConfig:
     # every in-flight stream for its whole prefill. 0 = monolithic
     # one-shot SP prefill. Rounded up to a seq-axis multiple.
     ring_prefill_chunk: int = 4096
+    # --- bounded-KV long-context serving (SnapStream-style; ISSUE 15) ---
+    # attention-sink + sliding-window KV with page-granular eviction
+    # (engine/kv_cache.py BoundedKVPolicy): a live session keeps the first
+    # ``kv_sink_pages`` pages PINNED (the attention sink — system head +
+    # earliest context) plus a window of the ``kv_window_pages`` most
+    # recent pages; older post-sink pages are evicted back to the page
+    # pool as the context grows, so a 100k-token session decodes at flat
+    # per-token cost and bounded page occupancy. Evicted pages simply
+    # leave the row's page list (the ragged kernel's per-row page
+    # indirection makes eviction free); positions/rotary stay ABSOLUTE
+    # while the KV gather walks the surviving pages. Both 0 = unbounded
+    # (legacy exact attention; requests longer than the page pool are
+    # rejected at submit).
+    kv_sink_pages: int = 0
+    # sliding-window pages for bounded-KV serving; must cover at least
+    # prefill_chunk + 2 pages so a prefill chunk always fits between
+    # eviction waves (validated at engine construction). 0 = unbounded.
+    kv_window_pages: int = 0
 
 
 @dataclass
@@ -615,6 +633,12 @@ def load_config(
     )
     cfg.engine.ring_prefill_chunk = _env_int(
         "FINCHAT_RING_PREFILL_CHUNK", cfg.engine.ring_prefill_chunk
+    )
+    cfg.engine.kv_sink_pages = _env_int(
+        "FINCHAT_KV_SINK_PAGES", cfg.engine.kv_sink_pages
+    )
+    cfg.engine.kv_window_pages = _env_int(
+        "FINCHAT_KV_WINDOW_PAGES", cfg.engine.kv_window_pages
     )
     cfg.engine.sp_mode = _env("FINCHAT_SP_MODE", cfg.engine.sp_mode)
     cfg.engine.kv_quant = _env("FINCHAT_KV_QUANT", cfg.engine.kv_quant)

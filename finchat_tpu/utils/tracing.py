@@ -84,6 +84,10 @@ TRACE_EVENTS = frozenset({
     # drain discarded stale residual ring tokens (replayed exactly once
     # via preempt/replay) — the capture/replay boundary on the timeline
     "freerun_epoch_break",
+    # bounded-KV eviction wave (ISSUE 15): args carry the evicted page
+    # count and the affected slots — page occupancy drops are attributable
+    # on the timeline without any per-token cost
+    "boundedkv_evict",
 })
 
 #: Anomaly kinds — each records an event AND triggers a flight dump.
