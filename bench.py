@@ -224,6 +224,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--quant-smoke", action="store_true",
                    help="tiny --quant-sweep variant for CI: same gates, "
                         "fewer tokens")
+    p.add_argument("--quantmatmul-smoke", action="store_true",
+                   help="CI gate for the fused dequant-matmul kernels "
+                        "(ISSUE 16): interpret-mode kernel-vs-ref parity "
+                        "across the int8/int4 layout matrix, fused-routing "
+                        "greedy stream byte-identity vs the inline-dequant "
+                        "reference at fp32 through the REAL scheduler, "
+                        "zero new compiled variants from the backend knob, "
+                        "fused-dispatch metric attribution, and a "
+                        "zero-leak audit")
     p.add_argument("--trace-overhead", action="store_true",
                    help="tracing-plane gate (ISSUE 12): traced vs untraced "
                         "decode throughput (< 2%% overhead), a schema-valid "
@@ -288,6 +297,8 @@ def run_worker(args: argparse.Namespace) -> int:
         result = measure_trace_overhead()
     elif args.quant_sweep or args.quant_smoke:
         result = measure_quant_sweep(smoke=args.quant_smoke)
+    elif args.quantmatmul_smoke:
+        result = measure_quantmatmul_smoke()
     elif args.durability_sweep or args.durability_smoke:
         result = measure_durability_sweep(smoke=args.durability_smoke)
     elif args.fleet_sweep or args.fleet_smoke:
@@ -2577,6 +2588,158 @@ def measure_quant_sweep(smoke: bool = False) -> dict:
     }
 
 
+def measure_quantmatmul_smoke() -> dict:
+    """CI gate for the fused dequant-matmul plane (ISSUE 16), CPU-runnable.
+
+    Four gates, mirroring the attention-kernel dispatch discipline:
+
+    1. ``quant_matmul_ref`` is BITWISE the historical inline-dequant math
+       (``x @ dequantize(w)``) — the reference IS the tier-1 serving path,
+       so routing every QTensor/Q4Tensor site through ops/dispatch.py
+       cannot move a stream byte on the default CPU backend.
+    2. Interpret-mode kernel-vs-ref parity on ragged int8 and per-group
+       int4 shapes (fp32-accumulating tiles: allclose, not bitwise).
+    3. Serving stream identity at fp32: an int8-quantized engine with the
+       fused backend (``pallas-interpret`` on CPU) must produce greedy
+       streams byte-identical to its inline-dequant twin through the REAL
+       scheduler, engage the fused path (fused_dispatches_total > 0 only
+       on the fused run), and compile EXACTLY as many warmup variants as
+       the reference engine — the backend knob is resolved once at
+       construction and multiplies nothing.
+    4. A zero-leak audit of both stopped schedulers.
+    """
+    import asyncio
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from finchat_tpu.analysis.sanitizers import scheduler_leak_report
+    from finchat_tpu.engine.engine import InferenceEngine
+    from finchat_tpu.engine.kv_cache import pages_needed
+    from finchat_tpu.engine.sampler import SamplingParams
+    from finchat_tpu.engine.scheduler import ContinuousBatchingScheduler
+    from finchat_tpu.models.llama import PRESETS
+    from finchat_tpu.models.quant import (
+        dequantize,
+        init_quantized_llama_params,
+        quantize,
+        quantize_int4,
+    )
+    from finchat_tpu.ops.quant_matmul import (
+        quant_matmul_int4,
+        quant_matmul_int8,
+        quant_matmul_ref,
+    )
+    from finchat_tpu.utils.config import EngineConfig
+    from finchat_tpu.utils.metrics import METRICS
+
+    rng = np.random.default_rng(0)
+
+    # --- gate 1+2: op-level reference pin and kernel parity ----------------
+    def _rand(shape):
+        return jnp.asarray(rng.standard_normal(shape), jnp.float32)
+
+    parity: list[dict] = []
+    ref_bitwise = True
+    for name, (M, K, N), mode, group in (
+        ("int8-ragged", (7, 130, 96), "int8", None),
+        ("int4-per-group-ragged", (5, 192, 80), "int4", 32),
+    ):
+        x, w = _rand((M, K)), _rand((K, N))
+        if mode == "int8":
+            qt = quantize(w)
+            out = quant_matmul_int8(x, qt.q, qt.scale, interpret=True)
+        else:
+            qt = quantize_int4(w, group_size=group)
+            out = quant_matmul_int4(x, qt.q, qt.scale, interpret=True)
+        ref = quant_matmul_ref(x, qt)
+        ref_bitwise &= bool(
+            np.array_equal(np.asarray(ref), np.asarray(x @ dequantize(qt, x.dtype)))
+        )
+        rel = float(np.max(np.abs(np.asarray(out) - np.asarray(ref)))
+                    / max(float(np.max(np.abs(np.asarray(ref)))), 1e-9))
+        parity.append({"case": name, "rel_err": round(rel, 9)})
+    parity_ok = all(p["rel_err"] < 1e-4 for p in parity)
+
+    # --- gate 3: fused vs inline-dequant serving streams at fp32 -----------
+    config = dataclasses.replace(PRESETS["tiny"], dtype=jnp.float32)
+    params = init_quantized_llama_params(config, jax.random.key(0), mode="int8")
+    page_size, n_new = 16, 12
+    prompts = [rng.integers(1, config.vocab_size, size=n).tolist()
+               for n in (44, 23)]
+    max_seq_len = max(len(p) for p in prompts) + n_new + 2 * page_size
+    pps = pages_needed(max_seq_len, page_size)
+
+    def run_backend(qm_backend):
+        ecfg = EngineConfig(max_seqs=2, page_size=page_size,
+                            num_pages=2 * pps + 4, max_seq_len=max_seq_len,
+                            prefill_chunk=32)
+        engine = InferenceEngine(config, params, ecfg, quant="int8",
+                                 qm_backend=qm_backend)
+        engine.warmup()
+        sched = ContinuousBatchingScheduler(engine, eos_id=-1)
+        fused0 = METRICS.snapshot().get(
+            "finchat_quantmatmul_fused_dispatches_total", 0)
+
+        async def go():
+            await sched.start()
+            try:
+                async def one(i, prompt):
+                    handle = await sched.submit(
+                        f"{qm_backend}-{i}", prompt,
+                        SamplingParams(temperature=0.0, max_new_tokens=n_new))
+                    toks = []
+                    while True:
+                        ev = await handle.events.get()
+                        if ev["type"] == "token":
+                            toks.append(ev["token_id"])
+                        elif ev["type"] == "done":
+                            return toks
+                        else:
+                            raise RuntimeError(str(ev))
+                return list(await asyncio.gather(
+                    *(one(i, p) for i, p in enumerate(prompts))))
+            finally:
+                await sched.stop()
+
+        streams = asyncio.run(go())
+        fused_d = METRICS.snapshot().get(
+            "finchat_quantmatmul_fused_dispatches_total", 0) - fused0
+        return streams, engine.compiled_variants, fused_d, \
+            scheduler_leak_report(sched)
+
+    ref_streams, ref_variants, ref_fused_d, leaks_r = run_backend("ref")
+    fus_streams, fus_variants, fus_fused_d, leaks_f = run_backend(
+        "pallas-interpret")
+    identical = ref_streams == fus_streams
+    print(f"[bench] quantmatmul: parity {parity}, streams identical="
+          f"{identical}, variants ref={ref_variants} fused={fus_variants}, "
+          f"fused dispatches {fus_fused_d}", file=sys.stderr, flush=True)
+
+    all_leaks = leaks_r + leaks_f
+    return {
+        "metric": "quantmatmul_smoke",
+        "unit": "rel logit delta, token streams",
+        "model": "tiny (fp32 — the identity-gate discipline)",
+        "parity": parity,
+        "parity_ok": parity_ok,
+        "ref_is_inline_dequant_bitwise": ref_bitwise,
+        "streams_identical_fused_vs_ref": identical,
+        "compiled_variants_ref": ref_variants,
+        "compiled_variants_fused": fus_variants,
+        "zero_new_compiled_variants": ref_variants == fus_variants,
+        "fused_dispatches_ref_run": ref_fused_d,
+        "fused_dispatches_fused_run": fus_fused_d,
+        "fused_engaged": fus_fused_d > 0 and ref_fused_d == 0,
+        "zero_leaks": not all_leaks,
+        "leak_report": all_leaks,
+        "platform": jax.devices()[0].platform,
+        "device": str(jax.devices()[0]),
+    }
+
+
 def measure_chaos_sweep(smoke: bool = False, rates: tuple = (0.05, 0.2)) -> dict:
     """Chaos benchmark of the resilience plane (ISSUE 5), CPU-runnable
     through the REAL scheduler on the tiny fp32 config (fp32 pins greedy
@@ -3625,6 +3788,8 @@ def spawn_worker(args: argparse.Namespace, platform: str, timeout: float) -> dic
         cmd += ["--fleet-smoke"] if args.fleet_smoke else ["--fleet-sweep"]
     if args.quant_sweep or args.quant_smoke:
         cmd += (["--quant-smoke"] if args.quant_smoke else ["--quant-sweep"])
+    if args.quantmatmul_smoke:
+        cmd += ["--quantmatmul-smoke"]
     if args.trace_overhead:
         cmd += ["--trace-overhead"]
     print(f"[bench] spawning {platform} worker (timeout {timeout:.0f}s)",

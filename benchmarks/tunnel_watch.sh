@@ -30,9 +30,17 @@ backoff=300
 artifact_state() {
   # BENCH_8B_r* (not BENCH_8B_*): the round-agnostic BENCH_8B_latest.json
   # SYMLINK must stay out of the fingerprint — its mtime is queue
-  # bookkeeping, not capture progress
-  stat -c '%n %s %Y' BENCH_8B_r*.json TTFT_r*_tpu*.json \
-    PALLAS_ONCHIP_*.json 2>/dev/null
+  # bookkeeping, not capture progress. Likewise an artifact whose body
+  # records error_kind=timeout is a WEDGE RECEIPT (pallas_onchip.py
+  # writes one after its in-process retries exhaust without the backend
+  # ever answering) — counting its mtime as progress would reset to the
+  # fast cadence exactly when the tunnel is wedged. A recorded "failure"
+  # DOES count: it ran on a live backend, so the window is real.
+  for f in BENCH_8B_r*.json TTFT_r*_tpu*.json PALLAS_ONCHIP_*.json; do
+    [ -e "$f" ] || continue
+    grep -q '"error_kind": "timeout"' "$f" 2>/dev/null && continue
+    stat -c '%n %s %Y' "$f"
+  done
 }
 
 while [ "$(date +%s)" -lt "$deadline" ]; do

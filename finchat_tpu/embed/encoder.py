@@ -118,13 +118,14 @@ def _layer_norm(x: Array, scale: Array, bias: Array, eps: float) -> Array:
     return out.astype(x.dtype) * scale + bias
 
 
-@partial(jax.jit, static_argnames=("config",))
+@partial(jax.jit, static_argnames=("config", "qm_backend"))
 def encode_batch(
     params: dict[str, Any],
     tokens: Array,  # [B, S] int32 (right-padded)
     lengths: Array,  # [B] int32 valid lengths
     *,
     config: BertConfig,
+    qm_backend: str = "ref",
 ) -> Array:
     """Encode a padded batch → L2-normalized embeddings [B, dim] fp32."""
     c = config
@@ -135,27 +136,31 @@ def encode_batch(
     valid = (jnp.arange(S)[None, :] < lengths[:, None])  # [B, S]
 
     def body(x, layer):
-        # quant_dense = plain ``x @ w`` on unquantized leaves, inline
-        # int8 dequant (fused into the dot's operand read) on QTensor
-        # leaves — the embed.quant path (quantize_bert_params)
-        qkv = quant_dense(x, layer["qkv"]) + layer["qkv_bias"]  # [B,S,3D]
+        # quant_dense = plain ``x @ w`` on unquantized leaves; QTensor
+        # leaves (the embed.quant path, quantize_bert_params) route via
+        # ops/dispatch.quant_matmul — the inline-dequant reference on
+        # CPU, the fused packed-read Pallas kernel under qm_backend
+        qkv = quant_dense(x, layer["qkv"], qm_backend=qm_backend) + layer["qkv_bias"]  # [B,S,3D]
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(B, S, c.n_heads, c.head_dim)
         k = k.reshape(B, S, c.n_heads, c.head_dim)
         v = v.reshape(B, S, c.n_heads, c.head_dim)
         attn = mha_reference(q, k, v, causal=False, kv_len=lengths)
         x = _layer_norm(
-            x + quant_dense(attn.reshape(B, S, -1), layer["attn_out"])
+            x + quant_dense(attn.reshape(B, S, -1), layer["attn_out"],
+                            qm_backend=qm_backend)
             + layer["attn_out_bias"],
             layer["ln1_scale"], layer["ln1_bias"], c.norm_eps,
         )
         # exact (erf) GELU — what BERT/bge checkpoints were trained with
         h = jax.nn.gelu(
-            (quant_dense(x, layer["mlp_in"]) + layer["mlp_in_bias"]).astype(jnp.float32),
+            (quant_dense(x, layer["mlp_in"], qm_backend=qm_backend)
+             + layer["mlp_in_bias"]).astype(jnp.float32),
             approximate=False,
         ).astype(x.dtype)
         x = _layer_norm(
-            x + quant_dense(h, layer["mlp_out"]) + layer["mlp_out_bias"],
+            x + quant_dense(h, layer["mlp_out"], qm_backend=qm_backend)
+            + layer["mlp_out_bias"],
             layer["ln2_scale"], layer["ln2_bias"], c.norm_eps,
         )
         return x, None
@@ -193,6 +198,15 @@ class EmbeddingEncoder:
         # (tests/test_quant_serving.py, bench --quant-sweep)
         self.params = quantize_bert_params(params) if quant else params
         self.quant = quant
+        # resolve the fused-matmul backend ONCE (ops/dispatch discipline:
+        # env must not be read inside the jitted encode); unquantized
+        # encoders pin "ref" so they don't add a compiled variant per env
+        if quant:
+            from finchat_tpu.ops.dispatch import quant_matmul_backend
+
+            self.qm_backend = quant_matmul_backend()
+        else:
+            self.qm_backend = "ref"
         self.tokenizer = tokenizer
         self.batch_size = batch_size
 
@@ -222,7 +236,8 @@ class EmbeddingEncoder:
         for row, seq in enumerate(ids):
             padded[row, : len(seq)] = seq[:bucket]
         out = encode_batch(
-            self.params, jnp.asarray(padded), jnp.asarray(lengths, jnp.int32), config=self.config
+            self.params, jnp.asarray(padded), jnp.asarray(lengths, jnp.int32),
+            config=self.config, qm_backend=self.qm_backend,
         )
         return np.asarray(out)
 

@@ -196,7 +196,7 @@ def _paged_attention_fn(
     return attention
 
 
-@partial(jax.jit, static_argnames=("config", "page_size", "attn_backend"), donate_argnums=(1,))
+@partial(jax.jit, static_argnames=("config", "page_size", "attn_backend", "qm_backend"), donate_argnums=(1,))
 def prefill_step(
     params: dict[str, Any],
     state: DecodeState,
@@ -208,6 +208,7 @@ def prefill_step(
     config: LlamaConfig,
     page_size: int,
     attn_backend: str = "ref",
+    qm_backend: str = "ref",
 ) -> tuple[DecodeState, Array]:
     """Run one prefill chunk for N sequences; returns (state,
     last-valid-token logits [N, vocab])."""
@@ -230,12 +231,13 @@ def prefill_step(
         params, tokens, positions,
         config=config, attention=attention,
         cache=(state.k_pages, state.v_pages, state.k_scales, state.v_scales),
-        return_hidden=True,
+        return_hidden=True, qm_backend=qm_backend,
     )
     last_hidden = jnp.take_along_axis(
         hidden, jnp.maximum(n_valid - 1, 0)[:, None, None], axis=1
     )[:, 0]  # [N, D]
-    last_logits = lm_head(params, last_hidden, config=config)  # [N, vocab]
+    last_logits = lm_head(params, last_hidden, config=config,
+                          qm_backend=qm_backend)  # [N, vocab]
 
     new_state = dataclasses.replace(
         state,
@@ -352,7 +354,7 @@ def _ring_segment_attention_fn(mesh, page_table: Array, prefix_pages: int,
     return attention
 
 
-@partial(jax.jit, static_argnames=("config", "page_size", "mesh", "prefix_pages", "sp_mode"), donate_argnums=(1,))
+@partial(jax.jit, static_argnames=("config", "page_size", "mesh", "prefix_pages", "sp_mode", "qm_backend"), donate_argnums=(1,))
 def ring_prefill_segment_step(
     params: dict[str, Any],
     state: DecodeState,
@@ -366,6 +368,7 @@ def ring_prefill_segment_step(
     mesh,
     prefix_pages: int,
     sp_mode: str = "ring",
+    qm_backend: str = "ref",
 ) -> tuple[DecodeState, Array]:
     """One segment of a chunked seq-sharded prefill (SURVEY §5.7c +
     VERDICT r4 weak #8): segments attend to the cached earlier segments
@@ -391,12 +394,13 @@ def ring_prefill_segment_step(
         params, tokens, positions,
         config=config, attention=attention,
         cache=(state.k_pages, state.v_pages, state.k_scales, state.v_scales),
-        return_hidden=True,
+        return_hidden=True, qm_backend=qm_backend,
     )
     last_hidden = jax.lax.dynamic_index_in_dim(
         hidden[0], jnp.maximum(n_valid - 1, 0), axis=0, keepdims=False
     )  # [D]
-    last_logits = lm_head(params, last_hidden, config=config)  # [vocab]
+    last_logits = lm_head(params, last_hidden, config=config,
+                          qm_backend=qm_backend)  # [vocab]
 
     new_state = dataclasses.replace(
         state,
@@ -409,7 +413,7 @@ def ring_prefill_segment_step(
     return new_state, last_logits
 
 
-@partial(jax.jit, static_argnames=("config", "page_size", "mesh", "sp_mode"), donate_argnums=(1,))
+@partial(jax.jit, static_argnames=("config", "page_size", "mesh", "sp_mode", "qm_backend"), donate_argnums=(1,))
 def ring_prefill_step(
     params: dict[str, Any],
     state: DecodeState,
@@ -421,6 +425,7 @@ def ring_prefill_step(
     page_size: int,
     mesh,
     sp_mode: str = "ring",
+    qm_backend: str = "ref",
 ) -> tuple[DecodeState, Array]:
     """Seq-sharded single-shot prefill for long RAG prompts (SURVEY §5.7c).
 
@@ -446,12 +451,13 @@ def ring_prefill_step(
         params, tokens, positions,
         config=config, attention=attention,
         cache=(state.k_pages, state.v_pages, state.k_scales, state.v_scales),
-        return_hidden=True,
+        return_hidden=True, qm_backend=qm_backend,
     )
     last_hidden = jax.lax.dynamic_index_in_dim(
         hidden[0], jnp.maximum(n_valid - 1, 0), axis=0, keepdims=False
     )  # [D]
-    last_logits = lm_head(params, last_hidden, config=config)  # [vocab]
+    last_logits = lm_head(params, last_hidden, config=config,
+                          qm_backend=qm_backend)  # [vocab]
 
     new_state = dataclasses.replace(
         state,
@@ -482,7 +488,8 @@ def commit_first_token(
 
 @partial(
     jax.jit,
-    static_argnames=("config", "page_size", "attn_backend", "return_logits"),
+    static_argnames=("config", "page_size", "attn_backend", "qm_backend",
+                     "return_logits"),
     donate_argnums=(1,),
 )
 def decode_step(
@@ -496,6 +503,7 @@ def decode_step(
     config: LlamaConfig,
     page_size: int,
     attn_backend: str = "ref",
+    qm_backend: str = "ref",
     return_logits: bool = False,
 ) -> tuple[DecodeState, Array, Array | None]:
     """One decode step for ALL slots; returns (state, next_tokens [max_seqs]).
@@ -522,6 +530,7 @@ def decode_step(
         params, tokens, positions,
         config=config, attention=attention,
         cache=(state.k_pages, state.v_pages, state.k_scales, state.v_scales),
+        qm_backend=qm_backend,
     )
     step_logits = logits[:, 0, :]  # [B, vocab]
 
@@ -634,6 +643,7 @@ def _ragged_round_math(
     config: LlamaConfig,
     page_size: int,
     attn_backend: str = "ref",
+    qm_backend: str = "ref",
     spec_width: int = 0,
     loop_depth: int = 1,
 ) -> tuple[DecodeState, Array, Array, Array, Array]:
@@ -691,7 +701,7 @@ def _ragged_round_math(
         params, tok_in[None], tok_pos[None],
         config=config, attention=attention,
         cache=(state.k_pages, state.v_pages, state.k_scales, state.v_scales),
-        return_hidden=True,
+        return_hidden=True, qm_backend=qm_backend,
     )
     h = hidden[0]  # [T, D]
 
@@ -705,7 +715,8 @@ def _ragged_round_math(
         (row_n_drafts > 0)[:, None], jnp.minimum(col, last_off), last_off
     )
     sel_idx = jnp.clip(q_start[:, None] + sel_off, 0, T - 1)  # [R, W]
-    logits = lm_head(params, h[sel_idx], config=config)  # [R, W, vocab] fp32
+    logits = lm_head(params, h[sel_idx], config=config,
+                     qm_backend=qm_backend)  # [R, W, vocab] fp32
     preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [R, W]
 
     # spec acceptance — verify_step's math over the packed drafts: draft
@@ -767,6 +778,7 @@ def _ragged_round_math(
                 config=config, attention=attn,
                 cache=(state.k_pages, state.v_pages,
                        state.k_scales, state.v_scales),
+                qm_backend=qm_backend,
             )
             step_logits = step_logits[:, 0, :]
             rng, sub = jax.random.split(state.rng)
@@ -794,8 +806,8 @@ def _ragged_round_math(
 
 @partial(
     jax.jit,
-    static_argnames=("config", "page_size", "attn_backend", "spec_width",
-                     "loop_depth"),
+    static_argnames=("config", "page_size", "attn_backend", "qm_backend",
+                     "spec_width", "loop_depth"),
     donate_argnums=(1,),
 )
 def ragged_mixed_step(
@@ -822,6 +834,7 @@ def ragged_mixed_step(
     config: LlamaConfig,
     page_size: int,
     attn_backend: str = "ref",
+    qm_backend: str = "ref",
     spec_width: int = 0,
     loop_depth: int = 1,
 ) -> tuple[DecodeState, Array, Array, Array, Array]:
@@ -869,13 +882,14 @@ def ragged_mixed_step(
         loop_active, loop_temperature, loop_top_p, loop_top_k, eos_id,
         jnp.ones((R,), bool),  # every row live: the host stepped this round
         config=config, page_size=page_size, attn_backend=attn_backend,
-        spec_width=spec_width, loop_depth=loop_depth,
+        qm_backend=qm_backend, spec_width=spec_width, loop_depth=loop_depth,
     )
 
 
 @partial(
     jax.jit,
-    static_argnames=("config", "page_size", "attn_backend", "loop_depth"),
+    static_argnames=("config", "page_size", "attn_backend", "qm_backend",
+                     "loop_depth"),
     donate_argnums=(1,),
 )
 def ragged_multi_round(
@@ -900,6 +914,7 @@ def ragged_multi_round(
     config: LlamaConfig,
     page_size: int,
     attn_backend: str = "ref",
+    qm_backend: str = "ref",
     loop_depth: int = 1,
 ) -> tuple[DecodeState, Array, Array, Array]:
     """The free-running serving loop (ISSUE 13): ``F = freerun_rounds``
@@ -960,7 +975,7 @@ def ragged_multi_round(
             no_drafts, temperature, top_p, top_k, lact,
             loop_temperature, loop_top_p, loop_top_k, eos_id, row_live,
             config=config, page_size=page_size, attn_backend=attn_backend,
-            spec_width=0, loop_depth=loop_depth,
+            qm_backend=qm_backend, spec_width=0, loop_depth=loop_depth,
         )
         # W = 1 (no spec rows): column 0 is every armed row's token
         return state, (emitted[:, 0], n_emitted, blk)
@@ -975,7 +990,8 @@ def ragged_multi_round(
 
 @partial(
     jax.jit,
-    static_argnames=("config", "page_size", "attn_backend", "loop_depth"),
+    static_argnames=("config", "page_size", "attn_backend", "qm_backend",
+                     "loop_depth"),
     donate_argnums=(1,),
 )
 def decode_loop_step(
@@ -990,6 +1006,7 @@ def decode_loop_step(
     config: LlamaConfig,
     page_size: int,
     attn_backend: str = "ref",
+    qm_backend: str = "ref",
     loop_depth: int = 4,
 ) -> tuple[DecodeState, Array]:
     """K fused decode iterations in ONE dispatch (``jax.lax.fori_loop``):
@@ -1043,6 +1060,7 @@ def decode_loop_step(
             params, tokens, positions,
             config=config, attention=attention,
             cache=(state.k_pages, state.v_pages, state.k_scales, state.v_scales),
+            qm_backend=qm_backend,
         )
         step_logits = logits[:, 0, :]  # [B, vocab]
 
@@ -1074,7 +1092,8 @@ def decode_loop_step(
 
 @partial(
     jax.jit,
-    static_argnames=("config", "page_size", "attn_backend", "return_logits"),
+    static_argnames=("config", "page_size", "attn_backend", "qm_backend",
+                     "return_logits"),
     donate_argnums=(1,),
 )
 def verify_step(
@@ -1090,6 +1109,7 @@ def verify_step(
     config: LlamaConfig,
     page_size: int,
     attn_backend: str = "ref",
+    qm_backend: str = "ref",
     return_logits: bool = False,
 ) -> tuple[DecodeState, Array, Array, Array | None]:
     """Speculative-decoding verify step (prompt-lookup style): one forward
@@ -1135,6 +1155,7 @@ def verify_step(
         params, tokens, positions,
         config=config, attention=attention,
         cache=(state.k_pages, state.v_pages, state.k_scales, state.v_scales),
+        qm_backend=qm_backend,
     )  # [B, K, vocab]
 
     preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, K]
@@ -1176,9 +1197,9 @@ class InferenceEngine:
 
     def __init__(self, config: LlamaConfig, params: dict[str, Any], engine_cfg: EngineConfig,
                  mesh=None, attn_backend: str | None = None, quant: str = "",
-                 quant_group: int = 0):
+                 quant_group: int = 0, qm_backend: str | None = None):
         from finchat_tpu.models.quant import validate_quant_mode
-        from finchat_tpu.ops.dispatch import attention_backend
+        from finchat_tpu.ops.dispatch import attention_backend, quant_matmul_backend
 
         validate_quant_mode(quant)
         if engine_cfg.compilation_cache_dir:
@@ -1200,6 +1221,16 @@ class InferenceEngine:
                 logger.warning("compilation cache unavailable: %s", e)
         self.config = config
         self.attn_backend = attn_backend or attention_backend()
+        # fused dequant-matmul backend (ops/quant_matmul.py): resolved ONCE
+        # here — dispatch discipline, same as attn_backend — and passed
+        # STATIC through every compiled step. Unquantized engines pin "ref"
+        # so the knob adds zero compiled variants for them (bf16 weights
+        # never reach the dispatcher anyway).
+        self.qm_backend = (qm_backend or quant_matmul_backend()) if quant else "ref"
+        # TP collective-overlap knob (ops/tp_overlap.py): surfaced on the
+        # engine for the manual-TP stage path and the metrics plane;
+        # default off — on CPU the serial psum IS the reference schedule
+        self.tp_overlap = engine_cfg.tp_overlap
         self.engine_cfg = engine_cfg
         self.page_size = engine_cfg.page_size
         # fused multi-step decode (decode_loop_step): tokens per dispatch;
@@ -1436,7 +1467,7 @@ class InferenceEngine:
         self.state, last_logits = ring_prefill_step(
             self.params, self.state, tokens, jnp.int32(slot), jnp.int32(n),
             config=self.config, page_size=self.page_size, mesh=self.mesh,
-            sp_mode=self.sp_mode,
+            sp_mode=self.sp_mode, qm_backend=self.qm_backend,
         )
         return last_logits
 
@@ -1484,7 +1515,7 @@ class InferenceEngine:
             jnp.int32(start_pos), jnp.int32(n),
             config=self.config, page_size=self.page_size, mesh=self.mesh,
             prefix_pages=self._prefix_page_bucket(start_pos),
-            sp_mode=self.sp_mode,
+            sp_mode=self.sp_mode, qm_backend=self.qm_backend,
         )
         return last_logits
 
@@ -1539,7 +1570,7 @@ class InferenceEngine:
                 jnp.asarray(chunk_tokens, jnp.int32), slots,
                 jnp.asarray(start, jnp.int32), jnp.asarray(n_valid, jnp.int32),
                 config=self.config, page_size=self.page_size,
-                attn_backend=self.attn_backend,
+                attn_backend=self.attn_backend, qm_backend=self.qm_backend,
             )
             for i, p in enumerate(prompts):
                 if n_valid[i] and r * C + n_valid[i] == len(p):
@@ -1592,7 +1623,7 @@ class InferenceEngine:
                 self.params, self.state, jnp.zeros((n, C), jnp.int32),
                 zeros, zeros, zeros,
                 config=self.config, page_size=self.page_size,
-                attn_backend=self.attn_backend,
+                attn_backend=self.attn_backend, qm_backend=self.qm_backend,
             )
             n_variants += 1
         if cfg.mixed_step:
@@ -1619,7 +1650,7 @@ class InferenceEngine:
                     jnp.zeros((R,), jnp.int32),
                     bflags, bz, bo, bk, jnp.int32(-1),
                     config=self.config, page_size=self.page_size,
-                    attn_backend=self.attn_backend,
+                    attn_backend=self.attn_backend, qm_backend=self.qm_backend,
                     spec_width=cfg.spec_tokens,
                     loop_depth=self.decode_loop_depth,
                 )
@@ -1643,7 +1674,7 @@ class InferenceEngine:
                         jnp.zeros((R,), jnp.int32),
                         jnp.zeros((F, B), bool), bz, bo, bk, jnp.int32(-1),
                         config=self.config, page_size=self.page_size,
-                        attn_backend=self.attn_backend,
+                        attn_backend=self.attn_backend, qm_backend=self.qm_backend,
                         loop_depth=self.decode_loop_depth,
                     )
                     n_variants += 1
@@ -1655,7 +1686,7 @@ class InferenceEngine:
             self.state, _, _ = decode_step(
                 self.params, self.state, inactive, temp, top_p, top_k,
                 config=self.config, page_size=self.page_size,
-                attn_backend=self.attn_backend, return_logits=return_logits,
+                attn_backend=self.attn_backend, qm_backend=self.qm_backend, return_logits=return_logits,
             )
             n_variants += 1
         if self.decode_loop_depth > 1:
@@ -1667,7 +1698,7 @@ class InferenceEngine:
                 self.params, self.state, inactive, temp, top_p, top_k,
                 jnp.int32(-1),
                 config=self.config, page_size=self.page_size,
-                attn_backend=self.attn_backend,
+                attn_backend=self.attn_backend, qm_backend=self.qm_backend,
                 loop_depth=self.decode_loop_depth,
             )
             n_variants += 1
@@ -1680,7 +1711,7 @@ class InferenceEngine:
                     self.params, self.state, inactive, zero_drafts, zero_n,
                     temp, top_p, top_k,
                     config=self.config, page_size=self.page_size,
-                    attn_backend=self.attn_backend, return_logits=return_logits,
+                    attn_backend=self.attn_backend, qm_backend=self.qm_backend, return_logits=return_logits,
                 )
                 n_variants += 1
         self.state, _ = commit_first_token(
@@ -1713,7 +1744,7 @@ class InferenceEngine:
                     self.params, self.state, jnp.zeros((1, S), jnp.int32),
                     jnp.int32(0), jnp.int32(0),
                     config=self.config, page_size=self.page_size,
-                    mesh=self.mesh, sp_mode=self.sp_mode,
+                    mesh=self.mesh, sp_mode=self.sp_mode, qm_backend=self.qm_backend,
                 )
                 n_variants += 1
                 if S >= top:
@@ -1731,7 +1762,7 @@ class InferenceEngine:
                         jnp.int32(0), jnp.int32(rc), jnp.int32(0),
                         config=self.config, page_size=self.page_size,
                         mesh=self.mesh, prefix_pages=pb,
-                        sp_mode=self.sp_mode,
+                        sp_mode=self.sp_mode, qm_backend=self.qm_backend,
                     )
                     n_variants += 1
                     if pb >= top_pb:
@@ -1749,13 +1780,16 @@ class InferenceEngine:
         self.compiled_variants = n_variants
         # the variant COUNT is quant-independent by construction (weight
         # dtype never keys a jit cache entry — the quantized tree swaps in
-        # under the same traced shapes), so the collapsed-matrix gauge
-        # stays comparable across modes; the label makes the mode visible
+        # under the same traced shapes), and qm_backend-independent too
+        # (resolved once at construction, one static value per engine —
+        # bench --quantmatmul-smoke gates ref/fused counts equal), so the
+        # collapsed-matrix gauge stays comparable across modes; the
+        # labels make mode and matmul backend visible
         logger.info(
-            "engine warmup [%s]: prefill batches %s + %d serving variants "
-            "compiled in %.1fs%s",
-            self.quant_label, prefill_batch_sizes, n_variants, elapsed,
-            cache_note,
+            "engine warmup [%s, qm=%s]: prefill batches %s + %d serving "
+            "variants compiled in %.1fs%s",
+            self.quant_label, self.qm_backend, prefill_batch_sizes,
+            n_variants, elapsed, cache_note,
         )
         return elapsed
 
@@ -1766,7 +1800,7 @@ class InferenceEngine:
         self.state, next_tokens, logits = decode_step(
             self.params, self.state, active, temperature, top_p, top_k,
             config=self.config, page_size=self.page_size,
-            attn_backend=self.attn_backend, return_logits=return_logits,
+            attn_backend=self.attn_backend, qm_backend=self.qm_backend, return_logits=return_logits,
         )
         return (next_tokens, logits) if return_logits else next_tokens
 
@@ -1815,7 +1849,7 @@ class InferenceEngine:
                 loop_active, loop_temperature, loop_top_p, loop_top_k,
                 jnp.int32(eos_id),
                 config=self.config, page_size=self.page_size,
-                attn_backend=self.attn_backend,
+                attn_backend=self.attn_backend, qm_backend=self.qm_backend,
                 spec_width=self.engine_cfg.spec_tokens,
                 loop_depth=self.decode_loop_depth,
             )
@@ -1843,7 +1877,7 @@ class InferenceEngine:
             loop_active, loop_temperature, loop_top_p, loop_top_k,
             jnp.int32(eos_id),
             config=self.config, page_size=self.page_size,
-            attn_backend=self.attn_backend,
+            attn_backend=self.attn_backend, qm_backend=self.qm_backend,
             loop_depth=self.decode_loop_depth,
         )
         return ring_tokens, ring_n, ring_blocks
@@ -1868,7 +1902,7 @@ class InferenceEngine:
             self.params, self.state, active, temperature, top_p, top_k,
             jnp.int32(eos_id),
             config=self.config, page_size=self.page_size,
-            attn_backend=self.attn_backend, loop_depth=K,
+            attn_backend=self.attn_backend, qm_backend=self.qm_backend, loop_depth=K,
         )
         return token_block
 
@@ -1888,6 +1922,6 @@ class InferenceEngine:
             self.params, self.state, active, drafts, n_drafts,
             temperature, top_p, top_k,
             config=self.config, page_size=self.page_size,
-            attn_backend=self.attn_backend, return_logits=return_logits,
+            attn_backend=self.attn_backend, qm_backend=self.qm_backend, return_logits=return_logits,
         )
         return (emitted, n_emitted, logits) if return_logits else (emitted, n_emitted)

@@ -478,6 +478,22 @@ class ContinuousBatchingScheduler:
         )
         self.metrics.inc("finchat_quant_dequant_fallbacks_total", 0.0)
         self.metrics.inc("finchat_quant_envelope_exceeded_total", 0.0)
+        # fused dequant-matmul plane (ops/quant_matmul.py): the resolved
+        # backend as a gauge (0=ref, 1=pallas-interpret, 2=pallas) plus
+        # pre-seeded dispatch/fallback counters — fused engagement (or a
+        # stacked-weight fallback) is visible from zero per replica
+        _qm = getattr(engine, "qm_backend", "ref")
+        self.metrics.set_gauge(
+            "finchat_quantmatmul_backend",
+            {"ref": 0, "pallas-interpret": 1, "pallas": 2}.get(_qm, 0),
+        )
+        self.metrics.inc("finchat_quantmatmul_fused_dispatches_total", 0.0)
+        self.metrics.inc("finchat_quantmatmul_fallbacks_total", 0.0)
+        # whether this engine's compiled steps route quantized matmuls
+        # through the fused kernel — one bool for the dispatch tally below
+        self._qm_fused = bool(
+            getattr(engine, "quant", "") and _qm != "ref"
+        )
         # shared-prefix KV cache: matched at admission so identical prompt
         # heads (the constant system prompt every conversation shares) are
         # prefilled ONCE per process instead of per request — see
@@ -745,6 +761,16 @@ class ContinuousBatchingScheduler:
         self.metrics.inc("finchat_partial_grafts_total")
         self._wakeup.set()
         return True
+
+    def _tally_dispatch(self) -> None:
+        """Count one enqueued device program (the PR 10 coexist
+        attribution); engines whose compiled steps route quantized matmuls
+        through the fused kernel also book it on
+        finchat_quantmatmul_fused_dispatches_total — every model dispatch
+        in that configuration reads packed weights."""
+        self._dispatch_tally += 1
+        if self._qm_fused:
+            self.metrics.inc("finchat_quantmatmul_fused_dispatches_total")
 
     def _trace_dispatch(self, kind: str, rows: list, *,
                         ts: float | None = None,
@@ -2354,7 +2380,7 @@ class ContinuousBatchingScheduler:
                         # chunked path below exists to avoid
                         with Timer(self.metrics, "finchat_prefill_seconds") as _pt:
                             ring_logits = eng.prefill_ring(handle.slot, handle.prompt_ids)
-                        self._dispatch_tally += 1
+                        self._tally_dispatch()
                         if TRACER.enabled:
                             self._trace_dispatch(
                                 "ring",
@@ -2376,7 +2402,7 @@ class ContinuousBatchingScheduler:
                         seg_logits = eng.prefill_ring_segment(
                             handle.slot, seg, handle.prefill_pos
                         )
-                    self._dispatch_tally += 1
+                    self._tally_dispatch()
                     if TRACER.enabled:
                         self._trace_dispatch(
                             "ring_segment",
@@ -2414,7 +2440,7 @@ class ContinuousBatchingScheduler:
                     config=eng.config, page_size=eng.page_size,
                     attn_backend=eng.attn_backend,
                 )
-            self._dispatch_tally += 1
+            self._tally_dispatch()
             if TRACER.enabled:
                 trows = [[h.slot, h.trace_id or h.seq_id, "prefill"] for h in batch]
                 trows += [[j.slot, f"prefix:{j.owner}", "prefix"] for j in jobs]
@@ -2552,7 +2578,11 @@ class ContinuousBatchingScheduler:
             self.metrics.inc("finchat_freerun_capped_total",
                              labels={"reason": "constrained"})
             return 1
-        if self.spec_k > 0 and self._spec_cooldown == 0 and self._spec_candidates():
+        if (self.spec_k > 0 and self._spec_cooldown == 0
+                and self._spec_proposal_live()):
+            # a proposal must ACTUALLY fire to cap the capture: eligible
+            # slots whose n-gram lookups all miss would run a plain decode
+            # round anyway (see _spec_proposal_live), so they free-run
             self.metrics.inc("finchat_freerun_capped_total",
                              labels={"reason": "spec"})
             return 1
@@ -2664,7 +2694,7 @@ class ContinuousBatchingScheduler:
                 jnp.asarray(self._temperature), jnp.asarray(self._top_p),
                 jnp.asarray(self._top_k), self.eos_id,
             )
-        self._dispatch_tally += 1
+        self._tally_dispatch()
         self._round_tally += rounds
         self.metrics.inc("finchat_freerun_dispatches_total")
         # unit is ROUNDS, not seconds: the N-rounds-per-1-dispatch
@@ -2994,7 +3024,7 @@ class ContinuousBatchingScheduler:
                 jnp.asarray(self._top_p), jnp.asarray(self._top_k),
                 self.eos_id,
             )
-        self._dispatch_tally += 1
+        self._tally_dispatch()
         if TRACER.enabled:
             # dispatch span piggybacking on the round's own row
             # bookkeeping (ISSUE 12): every (slot, trace, mode) row that
@@ -3181,7 +3211,7 @@ class ContinuousBatchingScheduler:
             jnp.asarray(self._top_k),
             return_logits=need_logits,
         )
-        self._dispatch_tally += 1
+        self._tally_dispatch()
         if TRACER.enabled:
             self._trace_dispatch(
                 "decode",
@@ -3288,7 +3318,7 @@ class ContinuousBatchingScheduler:
             jnp.asarray(self._top_k),
             eos_id=self.eos_id,
         )
-        self._dispatch_tally += 1
+        self._tally_dispatch()
         if TRACER.enabled:
             self._trace_dispatch(
                 "decode_loop",
@@ -3353,6 +3383,32 @@ class ContinuousBatchingScheduler:
         step — otherwise the pipelined depth-2 decode path is strictly
         better."""
         return any(self._spec_eligible(h) for h in self.decoding.values())
+
+    def _spec_proposal_live(self) -> bool:
+        """Would the spec path actually PROPOSE drafts this round? The
+        probe mirrors ``_run_spec_step``'s proposal loop exactly — lazy
+        one-time ``NgramIndex`` build included (``_deliver`` keeps the
+        index in sync afterwards, so building here is the same build the
+        spec step would do), same span cap, same ``propose`` lookup
+        (read-only). Eligibility alone (``_spec_candidates``) is NOT a
+        live proposal window: an eligible slot whose n-gram lookup misses
+        would make ``_run_spec_step`` fall back to the plain decode round
+        anyway, so capping a free-run capture for it threw away F-1
+        captured rounds for nothing — the streams are byte-identical
+        either way (spec verify is greedy-exact)."""
+        from finchat_tpu.engine.spec import NgramIndex
+
+        Kd = self.spec_k
+        for handle in self.decoding.values():
+            if not self._spec_eligible(handle):
+                continue
+            if handle.ngram_index is None:
+                handle.ngram_index = NgramIndex(handle.history)
+            remaining = handle.sampling.max_new_tokens - handle.generated
+            cap = min(Kd, remaining - 1, self._bounded_span_room(handle) - 1)
+            if cap > 0 and handle.ngram_index.propose(cap):
+                return True
+        return False
 
     def _constrained_pick(self, handle: SequenceHandle, row_logits) -> int:
         """Host-side grammar pick for one constrained slot: choose the
@@ -3442,7 +3498,7 @@ class ContinuousBatchingScheduler:
             jnp.asarray(self._top_k),
             return_logits=need_logits,
         )
-        self._dispatch_tally += 1
+        self._tally_dispatch()
         if TRACER.enabled:
             self._trace_dispatch(
                 "spec",

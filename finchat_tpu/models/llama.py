@@ -198,7 +198,8 @@ def rope(x: Array, positions: Array, theta: float) -> Array:
     return rotated.astype(x.dtype)
 
 
-def moe_mlp(h: Array, layer_params: dict[str, Array], config: LlamaConfig) -> Array:
+def moe_mlp(h: Array, layer_params: dict[str, Array], config: LlamaConfig,
+            qm_backend: str | None = None) -> Array:
     """Mixtral-style top-k routed SwiGLU experts, expert-parallel the GSPMD
     way: expert weights carry a leading E axis sharded over the mesh's
     ``expert`` axis (parallel/sharding.py), every expert computes over all
@@ -224,8 +225,15 @@ def moe_mlp(h: Array, layer_params: dict[str, Array], config: LlamaConfig) -> Ar
     gates = jnp.einsum("bske,bsk->bse", onehot, w).astype(h.dtype)  # [B,S,E]
 
     def expert_mm(spec: str, x: Array, w: Array | QTensor | Q4Tensor) -> Array:
-        # int8/int4 serving: inline dequant, fused into the dot's operand read
+        # int8/int4 serving: the stacked-expert einsums keep INLINE dequant
+        # (no fused kernel tiles the leading E axis — ops/dispatch
+        # quant_matmul counts the would-be route as a fallback); XLA fuses
+        # the upcast+scale into the dot's operand read where it can
         if isinstance(w, (QTensor, Q4Tensor)):
+            if qm_backend not in (None, "ref"):
+                from finchat_tpu.utils.metrics import METRICS
+
+                METRICS.inc("finchat_quantmatmul_fallbacks_total")
             w = dequantize(w, x.dtype)
         return jnp.einsum(spec, x, w)
 
@@ -247,41 +255,61 @@ def _layer(
     attention: AttentionFn,
     tp_axis: str | None = None,
     tp_size: int = 1,
+    tp_overlap: bool = False,
+    tp_chunks: int = 4,
+    qm_backend: str | None = None,
 ) -> tuple[Array, Any]:
     """One decoder layer. Under GSPMD (the usual path) ``tp_axis`` is
     None — the compiler partitions from the param shardings. Under an
     ALL-MANUAL ``shard_map`` (the stage pipeline, parallel/pipeline.py)
     pass the TP mesh axis + size: weights arrive as Megatron shards
     (column-parallel q/k/v/gate/up, row-parallel o/down), head counts are
-    local, and the two row-parallel outputs psum over ``tp_axis``."""
+    local, and the two row-parallel outputs all-reduce over ``tp_axis`` —
+    serially, or with the chunked collective–compute overlap schedule
+    (``tp_overlap``, ops/tp_overlap.py — byte-identical per element).
+    ``qm_backend`` routes quantized matmul leaves (ops/dispatch)."""
     c = config
     B, S, D = x.shape
     hq = c.n_heads // tp_size
     hkv = c.n_kv_heads // tp_size
 
     h = rms_norm(x, layer_params["ln_attn"], c.norm_eps)
-    q = dense(h, layer_params["attn_q"]).reshape(B, S, hq, c.head_dim)
-    k = dense(h, layer_params["attn_k"]).reshape(B, S, hkv, c.head_dim)
-    v = dense(h, layer_params["attn_v"]).reshape(B, S, hkv, c.head_dim)
+    q = dense(h, layer_params["attn_q"], qm_backend=qm_backend).reshape(B, S, hq, c.head_dim)
+    k = dense(h, layer_params["attn_k"], qm_backend=qm_backend).reshape(B, S, hkv, c.head_dim)
+    v = dense(h, layer_params["attn_v"], qm_backend=qm_backend).reshape(B, S, hkv, c.head_dim)
     q = rope(q, positions, c.rope_theta)
     k = rope(k, positions, c.rope_theta)
 
     attn_out, new_layer_cache = attention(q, k, v, layer_cache, layer_idx)
-    attn_proj = dense(attn_out.reshape(B, S, -1), layer_params["attn_o"])
     if tp_axis is not None:
-        attn_proj = jax.lax.psum(attn_proj, tp_axis)
+        from finchat_tpu.ops.tp_overlap import row_parallel_dense
+
+        attn_proj = row_parallel_dense(
+            attn_out.reshape(B, S, -1), layer_params["attn_o"], tp_axis,
+            overlap=tp_overlap, n_chunks=tp_chunks, qm_backend=qm_backend,
+        )
+    else:
+        attn_proj = dense(attn_out.reshape(B, S, -1), layer_params["attn_o"],
+                          qm_backend=qm_backend)
     x = x + attn_proj
 
     h = rms_norm(x, layer_params["ln_mlp"], c.norm_eps)
     if c.n_experts:
         assert tp_axis is None, "manual-TP stage blocks are dense-only (PPxEP future work)"
-        x = x + moe_mlp(h, layer_params, c)
+        x = x + moe_mlp(h, layer_params, c, qm_backend=qm_backend)
     else:
-        gate = dense(h, layer_params["mlp_gate"])
-        up = dense(h, layer_params["mlp_up"])
-        down = dense(jax.nn.silu(gate.astype(jnp.float32)).astype(up.dtype) * up, layer_params["mlp_down"])
+        gate = dense(h, layer_params["mlp_gate"], qm_backend=qm_backend)
+        up = dense(h, layer_params["mlp_up"], qm_backend=qm_backend)
+        act = jax.nn.silu(gate.astype(jnp.float32)).astype(up.dtype) * up
         if tp_axis is not None:
-            down = jax.lax.psum(down, tp_axis)
+            from finchat_tpu.ops.tp_overlap import row_parallel_dense
+
+            down = row_parallel_dense(
+                act, layer_params["mlp_down"], tp_axis,
+                overlap=tp_overlap, n_chunks=tp_chunks, qm_backend=qm_backend,
+            )
+        else:
+            down = dense(act, layer_params["mlp_down"], qm_backend=qm_backend)
         x = x + down
     return x, new_layer_cache
 
@@ -296,6 +324,7 @@ def forward(
     cache: Any = None,  # full-depth cache pytree (carried), or None
     remat: bool = False,  # checkpoint each scanned layer (training)
     return_hidden: bool = False,  # post-norm hidden states, no LM head
+    qm_backend: str | None = None,  # quantized-matmul backend (ops/dispatch)
 ) -> tuple[Array, Any]:
     """Run the decoder; returns (logits[B,S,vocab] fp32, new_cache) — or
     (hidden[B,S,D], new_cache) with ``return_hidden``, for callers that
@@ -319,6 +348,7 @@ def forward(
         x, cache = _layer(
             x, layer_params, cache, layer_idx,
             positions=positions, config=c, attention=attention,
+            qm_backend=qm_backend,
         )
         return (x, cache), None
 
@@ -333,15 +363,22 @@ def forward(
     x = rms_norm(x, params["norm"], c.norm_eps)
     if return_hidden:
         return x, new_cache
-    logits = lm_head(params, x, config=c)
+    logits = lm_head(params, x, config=c, qm_backend=qm_backend)
     return logits, new_cache
 
 
-def lm_head(params: dict[str, Any], x: Array, *, config: LlamaConfig) -> Array:
-    """Project hidden states [..., D] to fp32 logits [..., vocab]."""
+def lm_head(params: dict[str, Any], x: Array, *, config: LlamaConfig,
+            qm_backend: str | None = None) -> Array:
+    """Project hidden states [..., D] to fp32 logits [..., vocab]. A
+    quantized head routes through quant_matmul (the reference backend is
+    bitwise the historical dequantize-then-einsum; the fused kernel
+    accumulates fp32 and streams the head packed)."""
     head = params["embed"].T if config.tie_embeddings else params["lm_head"]
     if isinstance(head, (QTensor, Q4Tensor)):
-        head = dequantize(head, x.dtype)
+        from finchat_tpu.ops.dispatch import quant_matmul
+
+        return quant_matmul(x, head, backend=qm_backend,
+                            preferred_element_type=jnp.float32)
     return jnp.einsum("...d,dv->...v", x, head, preferred_element_type=jnp.float32)
 
 
@@ -367,13 +404,15 @@ def full_causal_attention(q: Array, k: Array, v: Array, layer_cache: Any, layer_
     return causal_attention(q, k, v), layer_cache
 
 
-@partial(jax.jit, static_argnames=("config", "attn_backend"))
+@partial(jax.jit, static_argnames=("config", "attn_backend", "qm_backend"))
 def _forward_full_jit(
-    params: dict[str, Any], tokens: Array, positions: Array, *, config: LlamaConfig, attn_backend: str
+    params: dict[str, Any], tokens: Array, positions: Array, *, config: LlamaConfig, attn_backend: str,
+    qm_backend: str | None = None,
 ) -> Array:
     logits, _ = forward(
         params, tokens, positions, config=config,
         attention=make_causal_attention(attn_backend), cache=None,
+        qm_backend=qm_backend,
     )
     return logits
 
@@ -381,11 +420,13 @@ def _forward_full_jit(
 def forward_full(
     params: dict[str, Any], tokens: Array, positions: Array, *,
     config: LlamaConfig, attn_backend: str | None = None,
+    qm_backend: str | None = None,
 ) -> Array:
     """Convenience jitted forward with full causal attention, no cache.
-    The backend resolves at CALL time and keys the jit cache."""
+    The backends resolve at CALL time and key the jit cache."""
     if attn_backend is None:
         from finchat_tpu.ops.dispatch import attention_backend
 
         attn_backend = attention_backend()
-    return _forward_full_jit(params, tokens, positions, config=config, attn_backend=attn_backend)
+    return _forward_full_jit(params, tokens, positions, config=config,
+                             attn_backend=attn_backend, qm_backend=qm_backend)

@@ -214,11 +214,21 @@ def quantize_stacked(w: Array, mode: str = "int8",
     return cls(q=q, scale=scale)
 
 
-def dense(x: Array, w: Array | QTensor | Q4Tensor) -> Array:
-    """``x @ w`` for a plain or quantized weight (inline dequantization —
-    see the module docstring for why not post-matmul scaling)."""
+def dense(x: Array, w: Array | QTensor | Q4Tensor, *,
+          qm_backend: str | None = None) -> Array:
+    """``x @ w`` for a plain or quantized weight. Quantized leaves route
+    through ``ops/dispatch.quant_matmul`` (PR 16): the reference backend
+    is BITWISE the historical inline dequant ``x @ dequantize(w, x.dtype)``
+    (see the module docstring for why not post-matmul scaling) and stays
+    the CPU/tier-1 serving path; the Pallas backend streams the weight
+    packed from HBM and dequantizes in the matmul tile loop, so the bf16
+    tensor never rematerializes per layer. ``qm_backend`` follows the
+    ops/dispatch contract: jitted callers (the engine) resolve once and
+    pass it statically; ``None`` resolves env at trace time."""
     if isinstance(w, (QTensor, Q4Tensor)):
-        return x @ dequantize(w, x.dtype)
+        from finchat_tpu.ops.dispatch import quant_matmul
+
+        return quant_matmul(x, w, backend=qm_backend)
     return x @ w
 
 

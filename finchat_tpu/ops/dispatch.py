@@ -1,9 +1,11 @@
-"""Attention backend dispatch: Pallas kernels on TPU, jnp references on CPU.
+"""Kernel backend dispatch: Pallas kernels on TPU, jnp references on CPU.
 
-One switch for the whole engine (SURVEY §7.2 step 4 wiring). Resolution
-order:
+One switch per kernel family for the whole engine (SURVEY §7.2 step 4
+wiring): ``FINCHAT_ATTN`` for the attention kernels and
+``FINCHAT_QUANT_MATMUL`` for the fused dequant-matmul plane. Resolution
+order (same for both):
 
-1. ``FINCHAT_ATTN`` env var: ``pallas`` | ``ref`` | ``pallas-interpret``
+1. the env var: ``pallas`` | ``ref`` | ``pallas-interpret``
    (the last runs the Pallas kernels through the interpreter on any backend
    — what the CI mesh uses to exercise kernel code paths without a TPU);
 2. default: ``pallas`` when the runtime backend is TPU, else ``ref``.
@@ -149,6 +151,73 @@ def ragged_paged_attention(
         q, k_pages, v_pages, page_table, tok_row, tok_pos, kv_len, layer,
         page_size=page_size, n_kv=n_kv, interpret=interpret,
         kv_gap=kv_gap,
+    )
+
+
+def quant_matmul_backend() -> str:
+    """Resolve the fused dequant-matmul backend (``FINCHAT_QUANT_MATMUL``:
+    ``pallas`` | ``ref`` | ``pallas-interpret``; default ``pallas`` on TPU,
+    ``ref`` elsewhere — the reference is the CPU/tier-1 serving path).
+    Same discipline as ``attention_backend``: jitted callers resolve ONCE
+    outside the trace and pass the result through (the engine keys its
+    compiled steps on it); a ``None`` backend reaching ``quant_matmul``
+    inside a trace resolves env at TRACE time and bakes that answer into
+    the jit cache."""
+    choice = os.getenv("FINCHAT_QUANT_MATMUL", "").strip().lower()
+    if choice:
+        if choice not in _VALID:
+            raise ValueError(
+                f"FINCHAT_QUANT_MATMUL must be one of {_VALID}, got {choice!r}"
+            )
+        return choice
+    return "pallas" if jax.default_backend() == "tpu" else "ref"
+
+
+def quant_matmul(
+    x: Array,
+    w,  # models/quant QTensor | Q4Tensor
+    *,
+    backend: str | None = None,
+    preferred_element_type=None,
+) -> Array:
+    """Quantized matmul via the requested (or default) backend: the fused
+    Pallas kernel streams the weight PACKED from HBM (ops/quant_matmul.py)
+    and dequantizes in-tile; the reference is bitwise the historical
+    inline-dequant math. Shapes the kernel does not tile — stacked
+    (ndim > 2) weight leaves, i.e. the MoE expert einsums — fall back to
+    the reference and count on ``finchat_quantmatmul_fallbacks_total``
+    (once per TRACE, not per dispatch: this routing runs at trace time
+    inside the engine's compiled steps)."""
+    from finchat_tpu.models.quant import Q4Tensor
+    from finchat_tpu.ops.quant_matmul import (
+        quant_matmul_int4,
+        quant_matmul_int8,
+        quant_matmul_ref,
+    )
+
+    backend = backend or quant_matmul_backend()
+    if backend != "ref" and w.q.ndim != 2:
+        from finchat_tpu.utils.metrics import METRICS
+
+        METRICS.inc("finchat_quantmatmul_fallbacks_total")
+        logger.warning(
+            "quant_matmul: no fused kernel for stacked weight shape %s; "
+            "falling back to the inline-dequant reference", w.q.shape,
+        )
+        backend = "ref"
+    if backend == "ref":
+        return quant_matmul_ref(
+            x, w, preferred_element_type=preferred_element_type
+        )
+    interpret = backend == "pallas-interpret"
+    if isinstance(w, Q4Tensor):
+        return quant_matmul_int4(
+            x, w.q, w.scale, interpret=interpret,
+            out_dtype=preferred_element_type,
+        )
+    return quant_matmul_int8(
+        x, w.q, w.scale, interpret=interpret,
+        out_dtype=preferred_element_type,
     )
 
 

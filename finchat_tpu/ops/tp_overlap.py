@@ -1,0 +1,101 @@
+"""TP collective–compute overlap for the row-parallel layer outputs.
+
+Under the all-manual TP stage path (parallel/pipeline.py →
+models/llama._layer) each decoder layer ends in TWO blocking
+``jax.lax.psum`` all-reduces — the attention output projection and the
+MLP down projection (models/llama.py). Every one serializes the model
+axis: the matmul must finish entirely before the collective starts, and
+the collective must finish before the residual add. Kernel Looping
+(PAPERS.md) names exactly this compute→collective boundary as the
+remaining headroom once the host syncs are gone (PR 13).
+
+``row_parallel_dense`` removes the boundary structurally: the
+row-parallel matmul is CHUNKED along its OUTPUT columns, and each chunk's
+partial-sum all-reduce is issued as soon as that chunk's matmul retires —
+XLA's async collectives then overlap chunk c's psum with chunk c+1's
+matmul (TPU all-reduces are async by default; on CPU the chunks simply
+run back to back). This is the "async psum" arm the ISSUE allows, chosen
+over a ppermute-pipelined reduce-scatter + all-gather ring deliberately:
+
+- BYTE-IDENTITY at every dtype, by construction. Chunking the output
+  axis leaves each output element's math untouched — the same full-K dot
+  followed by the same single n-way collective reduction. A ring
+  reduce-scatter reorders the cross-shard addition and is NOT bitwise at
+  reduced precision, which would break the manual-TP path's
+  bit-identical-to-unsharded contract (models/quant.py docstring,
+  tests/test_parallel.py). The fp32 byte-identity pin plus the bf16
+  envelope in tests/test_parallel.py hold à la the ring-prefill
+  promotion.
+- The chunk loop is trace-visible: the jaxpr carries ``n_chunks`` psum
+  eqns instead of one, which is the dispatch/trace evidence the
+  tp_overlap test asserts (engagement is observable, not just a knob).
+
+Quantized weights chunk WITHOUT unpacking: a QTensor slices its int8
+columns and per-column scales, a Q4Tensor slices its packed bytes' N
+axis (the nibble pair lives along K, inside one byte — column slices
+never split it), so each chunk still routes through the fused
+quant_matmul path reading packed HBM.
+
+Gate: ``engine.tp_overlap`` / ``FINCHAT_TP_OVERLAP`` (default off —
+on CPU there is nothing to overlap and the serial psum is the reference
+schedule), threaded through ``pipeline_forward`` and ``_layer``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from finchat_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def _slice_out_cols(w, start: int, size: int):
+    """Slice the OUTPUT (last) axis of a plain or quantized weight."""
+    from finchat_tpu.models.quant import Q4Tensor, QTensor
+
+    if isinstance(w, QTensor):
+        return QTensor(q=w.q[..., start:start + size],
+                       scale=w.scale[..., start:start + size])
+    if isinstance(w, Q4Tensor):
+        return Q4Tensor(q=w.q[..., start:start + size],
+                        scale=w.scale[..., start:start + size])
+    return w[..., start:start + size]
+
+
+def row_parallel_dense(
+    x: Array,
+    w,  # Array | QTensor | Q4Tensor — the row-parallel shard [K_local, N]
+    axis: str,
+    *,
+    overlap: bool = False,
+    n_chunks: int = 4,
+    qm_backend: str | None = None,
+) -> Array:
+    """``psum(x @ w, axis)`` — the row-parallel layer output — either as
+    the serial matmul + one blocking all-reduce (``overlap=False``, the
+    reference schedule) or as ``n_chunks`` output-column chunks whose
+    per-chunk psums overlap the next chunk's matmul. Both schedules are
+    byte-identical per element (see module docstring); indivisible output
+    dims fall back to serial with a warning."""
+    from finchat_tpu.models.quant import dense
+
+    N = w.shape[-1]
+    if overlap and (n_chunks <= 1 or N % n_chunks):
+        logger.warning(
+            "tp_overlap: output dim %d not divisible into %d chunks; "
+            "running the serial collective", N, n_chunks,
+        )
+        overlap = False
+    if not overlap:
+        return jax.lax.psum(dense(x, w, qm_backend=qm_backend), axis)
+    size = N // n_chunks
+    outs = []
+    for c in range(n_chunks):
+        wc = _slice_out_cols(w, c * size, size)
+        # issue the chunk's all-reduce immediately: the next chunk's dot
+        # has no data dependence on it, so the XLA scheduler can overlap
+        outs.append(jax.lax.psum(dense(x, wc, qm_backend=qm_backend), axis))
+    return jnp.concatenate(outs, axis=-1)

@@ -72,7 +72,8 @@ logger = get_logger(__name__)
 
 
 def _stage_block(x, layers_local, positions, *, config, attention, remat,
-                 tp_axis, tp_size):
+                 tp_axis, tp_size, tp_overlap=False, tp_chunks=4,
+                 qm_backend=None):
     """Run this stage's local layer block (scan over L/P layers)."""
 
     def body(x, scanned):
@@ -81,6 +82,8 @@ def _stage_block(x, layers_local, positions, *, config, attention, remat,
             x, layer_params, None, jnp.int32(0),
             positions=positions, config=config, attention=attention,
             tp_axis=tp_axis, tp_size=tp_size,
+            tp_overlap=tp_overlap, tp_chunks=tp_chunks,
+            qm_backend=qm_backend,
         )
         return x, None
 
@@ -102,6 +105,9 @@ def _pipeline_body(
     remat: bool,
     tp_axis,
     tp_size: int,
+    tp_overlap: bool,
+    tp_chunks: int,
+    qm_backend,
     carry_varying: tuple,
 ):
     """Per-device pipeline schedule under shard_map (manual axis: pipe)."""
@@ -134,6 +140,8 @@ def _pipeline_body(
             act, layers_local, pos_mb,
             config=config, attention=attention, remat=remat,
             tp_axis=tp_axis, tp_size=tp_size,
+            tp_overlap=tp_overlap, tp_chunks=tp_chunks,
+            qm_backend=qm_backend,
         )
         # bank the last stage's finished microbatch t-(P-1)
         out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1) * mb
@@ -220,12 +228,19 @@ def pipeline_forward(
     n_micro: int,
     attn_backend: str = "ref",
     remat: bool = True,
+    tp_overlap: bool = False,
+    tp_chunks: int = 4,
+    qm_backend: str | None = None,
 ) -> jax.Array:
     """Full forward through the stage pipeline; returns logits [B,S,vocab].
 
     Requires ``n_layers % pipe == 0`` and ``B % n_micro == 0``. Embedding,
     final norm, and the LM head run replicated outside the pipeline (they
-    are small next to the layer stack)."""
+    are small next to the layer stack). ``tp_overlap`` (engine.tp_overlap
+    / FINCHAT_TP_OVERLAP) switches the in-stage row-parallel outputs from
+    the serial layer-end psum to the chunked collective–compute overlap
+    schedule (ops/tp_overlap.py) — byte-identical per element, engaged
+    only when the model axis is actually active."""
     n_stages = mesh.shape["pipe"]
     assert config.n_layers % n_stages == 0, (config.n_layers, n_stages)
     # in-stage DP: the batch dim shards over `data` INTO the pipeline
@@ -282,6 +297,8 @@ def pipeline_forward(
             _pipeline_body,
             config=config, n_micro=n_micro, n_stages=n_stages,
             attention=attention, remat=remat, tp_axis=tp_axis, tp_size=tp,
+            tp_overlap=tp_overlap and tp > 1, tp_chunks=tp_chunks,
+            qm_backend=qm_backend,
             carry_varying=dp_axes + ("pipe",) + seq_axes,
         ),
         mesh=mesh,
@@ -303,6 +320,8 @@ def make_pipeline_train_step(
     n_micro: int,
     attn_backend: str = "ref",
     remat: bool = True,
+    tp_overlap: bool = False,
+    tp_chunks: int = 4,
 ):
     """Jitted train step running the forward through the stage pipeline.
 
@@ -322,6 +341,7 @@ def make_pipeline_train_step(
             params, tokens, positions,
             config=config, mesh=mesh, n_micro=n_micro,
             attn_backend=attn_backend, remat=remat,
+            tp_overlap=tp_overlap, tp_chunks=tp_chunks,
         )
         targets = tokens[:, 1:]
         ce = optax.softmax_cross_entropy_with_integer_labels(logits[:, :-1, :], targets)

@@ -310,6 +310,18 @@ class EngineConfig:
     # Streams stay byte-identical to the round-stepped path (fp32
     # contract; bench --freerun-sweep gates it). Requires mixed_step.
     freerun_rounds: int = 1
+    # TP collective-compute overlap (ops/tp_overlap.py): the manual-TP
+    # stage path chunks each row-parallel output projection so every
+    # chunk's partial-sum all-reduce overlaps the next chunk's matmul —
+    # byte-identical per element to the serial psum schedule at every
+    # dtype (the chunk split never touches an output element's K
+    # reduction or its single n-way collective). Default off: on CPU
+    # there is nothing to overlap and the serial collective is the
+    # reference schedule the parity tests pin against.
+    tp_overlap: bool = False
+    # output-column chunks per row-parallel matmul when tp_overlap is on
+    # (indivisible output dims fall back to serial with a warning)
+    tp_overlap_chunks: int = 4
     # persistent XLA compilation cache directory
     # (jax_compilation_cache_dir): warmup's compiles land on disk and a
     # restarted process reloads them instead of re-paying full XLA
@@ -678,6 +690,10 @@ def load_config(
     cfg.engine.mixed_step = _env_bool("FINCHAT_MIXED_STEP", cfg.engine.mixed_step)
     cfg.engine.freerun_rounds = _env_int(
         "FINCHAT_FREERUN_ROUNDS", cfg.engine.freerun_rounds
+    )
+    cfg.engine.tp_overlap = _env_bool("FINCHAT_TP_OVERLAP", cfg.engine.tp_overlap)
+    cfg.engine.tp_overlap_chunks = _env_int(
+        "FINCHAT_TP_OVERLAP_CHUNKS", cfg.engine.tp_overlap_chunks
     )
     cfg.engine.compilation_cache_dir = _env(
         "FINCHAT_COMPILATION_CACHE_DIR", cfg.engine.compilation_cache_dir

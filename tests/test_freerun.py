@@ -618,3 +618,53 @@ def test_freerun_config_env_reader(monkeypatch):
     assert load_config().engine.freerun_rounds == 8
     monkeypatch.delenv("FINCHAT_FREERUN_ROUNDS")
     assert load_config().engine.freerun_rounds == 1  # host-stepped default
+
+
+def test_freerun_spec_caps_only_on_live_proposal(params):
+    """Eligibility alone must NOT cap a capture (the PR 16 fix): a greedy
+    spec-eligible slot whose suffix n-gram never recurred would make the
+    spec step fall back to a plain decode round anyway, so the capture
+    free-runs. Only a history whose n-gram lookup actually PROPOSES
+    drafts caps to 1 (and books the "spec" reason)."""
+    from types import SimpleNamespace
+
+    sched = _stack(params, freerun=4, spec_tokens=2)
+
+    def handle(history):
+        # seq_id/slot keep the teardown leak audit happy (slot=-1 = none)
+        return SimpleNamespace(
+            constraint=None, seq_id="spec-probe", slot=-1,
+            sampling=SamplingParams(temperature=0.0, max_new_tokens=64),
+            generated=4, history=list(history), ngram_index=None,
+        )
+
+    spec_caps = lambda: METRICS.get(  # noqa: E731 — tiny probe
+        "finchat_freerun_capped_total", labels={"reason": "spec"})
+
+    # eligible slot, non-recurring history: no proposal -> full capture
+    before = spec_caps()
+    sched.decoding = {0: handle([1, 2, 3, 4, 5, 6, 7, 8])}
+    assert sched._freerun_rounds_cap() == 4
+    assert spec_caps() == before
+    # the probe built the index lazily, exactly as the spec step would
+    assert sched.decoding[0].ngram_index is not None
+
+    # recurring suffix n-gram: a proposal WOULD fire -> cap to 1 + metric
+    sched.decoding = {0: handle([5, 6, 7, 9, 5, 6, 7])}
+    assert sched._freerun_rounds_cap() == 1
+    assert spec_caps() == before + 1
+
+    # spec disabled entirely: same recurring history free-runs
+    sched.spec_k = 0
+    assert sched._freerun_rounds_cap() == 4
+    sched.decoding = {}
+
+
+def test_freerun_spec_eligible_no_proposal_byte_identical(params):
+    """With spec on and no grammar rows, captures now ENGAGE whenever no
+    n-gram proposal is live — the streams must stay byte-identical to the
+    host-stepped path across the engage/cap flips (spec verify is
+    greedy-exact, so either path is the same stream)."""
+    base, _ = _run_workload(params, 1, spec_tokens=2, seed=11)
+    fr, win = _run_workload(params, 4, spec_tokens=2, seed=11)
+    assert fr == base
