@@ -55,6 +55,19 @@ def main() -> None:
                         "(serve/fleet.py): conversation-affinity routing, "
                         "breaker drains to siblings, supervised respawn; "
                         "1 = single engine — also FINCHAT_FLEET_REPLICAS")
+    p.add_argument("--fleet-roles", default=None,
+                   help="comma-separated per-replica roles, e.g. "
+                        "'prefill,decode,decode' (serve/disagg.py): prefill "
+                        "replicas run cold prompts and hand the KV to the "
+                        "decode/mixed serving pool over the drain-handoff "
+                        "path; empty = all mixed — also FINCHAT_FLEET_ROLES")
+    p.add_argument("--fabric-path", default=None,
+                   help="cluster-wide warm-state fabric directory (engine/"
+                        "warm_fabric.py): one shared session disk tier + "
+                        "global index, so any replica resumes any "
+                        "conversation warm and shared prompt heads prefill "
+                        "once per fleet; implies fabric.enabled — also "
+                        "FINCHAT_FABRIC_PATH")
     p.add_argument("--journal-dir", default=None,
                    help="durability directory (io/journal.py; ISSUE 7): "
                         "answered message ids journal here (fsync before "
@@ -96,6 +109,11 @@ def main() -> None:
         overrides["engine.request_deadline_seconds"] = args.request_deadline_seconds
     if args.fleet_replicas is not None:
         overrides["fleet.replicas"] = args.fleet_replicas
+    if args.fleet_roles is not None:
+        overrides["fleet.roles"] = args.fleet_roles
+    if args.fabric_path is not None:
+        overrides["fabric.path"] = args.fabric_path
+        overrides["fabric.enabled"] = True
     if args.journal_dir is not None:
         overrides["journal.path"] = args.journal_dir
     if args.session_disk is not None:

@@ -353,7 +353,8 @@ class ContinuousBatchingScheduler:
     SPEC_RETRY_EVERY = 16
 
     def __init__(self, engine: InferenceEngine, eos_id: int,
-                 metrics=None, replica_id: str | None = None):
+                 metrics=None, replica_id: str | None = None,
+                 fabric=None):
         self.engine = engine
         self.eos_id = eos_id
         # fleet identity (serve/fleet.py): ``replica_id`` tags this
@@ -558,6 +559,25 @@ class ContinuousBatchingScheduler:
         # breaker state gauge: 0 closed, 1 open (rebuilding), 2 half-open
         # (rebuilt, awaiting the first successful probe round)
         self.metrics.set_gauge("finchat_breaker_state", 0)
+        # warm-state fabric (engine/warm_fabric.py — ISSUE 17): when set,
+        # this replica's session tier is the fleet's SHARED disk tier,
+        # shared prompt heads restore from / publish to the fabric instead
+        # of re-prefilling per replica, and the cache keeps the fabric's
+        # global holder index current. None = the per-replica PR 7 layout.
+        self.fabric = fabric
+        # disaggregated serving (serve/disagg.py — ISSUE 17): the fleet
+        # attaches its DisaggCoordinator to SERVING-pool schedulers only;
+        # submit routes cold prompt prefills through it when set
+        self.disagg = None
+        if fabric is not None:
+            # fabric accounting is per calling replica (R5: pre-seeded so
+            # the zero state is visible): hits/misses at head registration
+            # and shared-tier session restore, refusals on cross-mode RAM
+            # head snapshots (disk-record refusals count on the tier's own
+            # replica="fabric" view)
+            self.metrics.inc("finchat_fabric_hits_total", 0.0)
+            self.metrics.inc("finchat_fabric_misses_total", 0.0)
+            self.metrics.inc("finchat_fabric_import_refused_total", 0.0)
         # session KV cache (engine/session_cache.py): host-RAM tier keyed by
         # conversation_id; None = disabled. The on_drop hook is where entry
         # references on shared-prefix pages are released.
@@ -573,10 +593,15 @@ class ContinuousBatchingScheduler:
             # a RAM miss at admission falls back to disk, so a restarted
             # process resumes conversations warm. Fleet replicas get
             # sibling subdirectories (replica ids are stable across
-            # restarts, and migration handles the cross-replica moves).
+            # restarts, and migration handles the cross-replica moves) —
+            # unless the warm-state fabric is on, in which case every
+            # replica shares the fabric's ONE tier (ISSUE 17) and any
+            # replica restores any conversation.
             disk = None
             disk_path = getattr(cfg, "session_cache_disk_path", "")
-            if disk_path:
+            if fabric is not None:
+                disk = fabric.tier
+            elif disk_path:
                 if replica_id is not None:
                     import os as _os
 
@@ -595,6 +620,7 @@ class ContinuousBatchingScheduler:
             self.session_cache = SessionKVCache(
                 cfg.session_cache_bytes, page_size=cfg.page_size,
                 on_drop=self._session_drop, metrics=self.metrics, disk=disk,
+                fabric=fabric, fabric_replica=replica_id,
             )
 
     # --- public API -----------------------------------------------------
@@ -671,6 +697,20 @@ class ContinuousBatchingScheduler:
             import dataclasses as _dc
 
             sampling = _dc.replace(sampling, top_k=CANDIDATES)
+        if self.disagg is not None and conversation_id:
+            # disaggregated serving (ISSUE 17): a cold prompt prefills on
+            # the prefill pool and its KV arrives through the session
+            # tier BEFORE admission, so the match below resumes from it.
+            # Best-effort: any failure just leaves the local prefill path.
+            try:
+                await self.disagg.maybe_prefill(
+                    self, prompt_ids, conversation_id, trace_id=trace_id
+                )
+            except Exception as e:
+                logger.error("disagg handoff for %s failed: %s",
+                             conversation_id, e)
+                self.metrics.inc("finchat_disagg_fallbacks_total",
+                                 labels={"reason": "prefill_error"})
         handle = SequenceHandle(
             seq_id=seq_id, prompt_ids=list(prompt_ids), sampling=sampling,
             constraint=constraint, conversation_id=conversation_id,
@@ -861,6 +901,13 @@ class ContinuousBatchingScheduler:
         if not isinstance(prep, tuple):
             return prep  # 0 (unregistrable) or an existing entry's length
         ids, shared_len, owner, pages, slot = prep
+        if self._fabric_restore_head(ids, shared_len, pages):
+            # warm-state fabric hit (ISSUE 17): the head's KV scattered
+            # straight into the reserved pages — no prefill dispatches,
+            # and the slot reservation was never used
+            self.free_slots.append(slot)
+            self._prefixes.append(_PrefixEntry(ids, pages, shared_len, owner))
+            return shared_len
         try:
             self.engine.set_page_table_row(slot, pages)
             self.engine.prefill(slot, ids)  # fills exactly the shared pages
@@ -878,9 +925,56 @@ class ContinuousBatchingScheduler:
                 logger.error("slot reset failed after prefix prefill: %s", e)
             self.free_slots.append(slot)
         self._prefixes.append(_PrefixEntry(ids, pages, shared_len, owner))
+        self._fabric_store_head(ids, pages)
         logger.info("prefix cache: registered %d shared tokens (%d pages)",
                     shared_len, len(pages))
         return shared_len
+
+    def _fabric_restore_head(self, ids: list[int], shared_len: int,
+                             pages: list[int]) -> bool:
+        """Try to serve a head registration from the warm-state fabric
+        (ISSUE 17): a hit scatters the fleet-shared snapshot into the
+        reserved ``pages`` with one H2D copy instead of re-running the
+        prefill. Counts hit/miss/refusal on THIS replica's metrics; a
+        cross-mode snapshot is refused (scattering it would value-cast
+        into garbage KV — the import_session_entry discipline)."""
+        if self.fabric is None:
+            return False
+        snap = self.fabric.load_head(ids)
+        if snap is None:
+            self.metrics.inc("finchat_fabric_misses_total")
+            return False
+        from finchat_tpu.engine.session_cache import snap_kv_mode
+
+        if snap_kv_mode(snap) != self.engine.kv_quant:
+            self.metrics.inc("finchat_fabric_import_refused_total")
+            return False
+        try:
+            t0 = time.perf_counter()
+            self.engine.restore_pages(pages, snap)
+        except Exception as e:
+            logger.error("fabric head restore failed (%d tokens): %s — "
+                         "falling back to local prefill", shared_len, e)
+            return False
+        self.metrics.inc("finchat_fabric_hits_total")
+        self.metrics.observe("finchat_fabric_restore_seconds",
+                             time.perf_counter() - t0)
+        if TRACER.enabled:
+            TRACER.event("fabric_hit", track="fabric",
+                         args={"kind": "head", "tokens": shared_len})
+        logger.info("prefix cache: head (%d shared tokens) restored from "
+                    "the warm fabric", shared_len)
+        return True
+
+    def _fabric_store_head(self, ids: list[int], pages: list[int]) -> None:
+        """Publish a freshly-prefilled head fleet-wide (best-effort: the
+        fabric is an optimization, registration already succeeded)."""
+        if self.fabric is None:
+            return
+        try:
+            self.fabric.store_head(ids, self.engine.offload_pages(pages))
+        except Exception as e:
+            logger.error("fabric head publish failed: %s", e)
 
     def _prefix_prep(self, prompt_ids: list[int]):
         """Shared admission logic for both register_prefix variants: size
@@ -939,6 +1033,13 @@ class ContinuousBatchingScheduler:
         if not isinstance(prep, tuple):
             return prep
         ids, shared_len, owner, pages, slot = prep
+        if self._fabric_restore_head(ids, shared_len, pages):
+            # fabric hit (ISSUE 17): one H2D scatter, no prefill rounds —
+            # the chunked-job machinery (and its decode interleaving
+            # rationale) is moot when nothing prefills
+            self.free_slots.append(slot)
+            self._prefixes.append(_PrefixEntry(ids, pages, shared_len, owner))
+            return shared_len
         job = _PrefixJob(
             ids=ids, shared_len=shared_len, owner=owner, pages=pages,
             slot=slot, future=asyncio.get_running_loop().create_future(),
@@ -1896,8 +1997,15 @@ class ContinuousBatchingScheduler:
         refcounts work identically. Returns True when the entry is now
         resident in RAM."""
         cache = self.session_cache
-        if cache is None or cache.disk is None or conversation_id not in cache.disk:
+        if cache is None or cache.disk is None:
             return False
+        if conversation_id not in cache.disk:
+            if self.fabric is not None:
+                # with the shared tier this IS the fleet-wide lookup: a
+                # miss means no replica ever retired this conversation
+                self.metrics.inc("finchat_fabric_misses_total")
+            return False
+        t0 = time.perf_counter()
         with Timer(self.metrics, "finchat_durability_restore_seconds"):
             payload = cache.disk.load(conversation_id)
             if payload is None:
@@ -1920,6 +2028,19 @@ class ContinuousBatchingScheduler:
                 return False
         if ok:
             self.metrics.inc("finchat_durability_disk_restores_total")
+            if self.fabric is not None:
+                # the record came off the fleet-shared tier: ANY replica's
+                # retirement (or a handoff) could have written it — this
+                # replica resumes it warm without ever having seen it
+                self.metrics.inc("finchat_fabric_hits_total")
+                self.metrics.observe("finchat_fabric_restore_seconds",
+                                     time.perf_counter() - t0)
+                if TRACER.enabled:
+                    TRACER.event("fabric_hit", track="fabric",
+                                 args={"kind": "session",
+                                       "key": conversation_id})
+        elif self.fabric is not None:
+            self.metrics.inc("finchat_fabric_misses_total")
         return ok
 
     def spill_sessions(self) -> int:

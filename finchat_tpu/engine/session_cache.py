@@ -694,7 +694,8 @@ class SessionKVCache:
 
     def __init__(self, budget_bytes: int, page_size: int,
                  on_drop: Callable[[SessionEntry], None] | None = None,
-                 metrics=None, disk: SessionDiskTier | None = None):
+                 metrics=None, disk: SessionDiskTier | None = None,
+                 fabric=None, fabric_replica: str | None = None):
         assert budget_bytes > 0 and page_size > 0
         self.budget_bytes = budget_bytes
         self.page_size = page_size
@@ -708,6 +709,13 @@ class SessionKVCache:
         # via the scheduler (_restore_session_from_disk, which re-links
         # shared heads); None = host-RAM only (pre-ISSUE-7 behavior)
         self.disk = disk
+        # warm-state fabric (ISSUE 17): when set, ``disk`` IS the fleet's
+        # shared tier and this cache keeps the fabric's global RAM index
+        # current — put notes this replica as the key's holder, drops
+        # forget it (holder-guarded) — so the router's deeper-entry-wins
+        # migration is an index lookup instead of a pairwise scan
+        self.fabric = fabric
+        self.fabric_replica = fabric_replica
         self._entries: OrderedDict[str, SessionEntry] = OrderedDict()
         self._resident_bytes = 0
         self._publish_gauges()
@@ -759,6 +767,10 @@ class SessionKVCache:
             self.metrics.inc("finchat_session_cache_evictions_total")
             logger.debug("session cache: evicted %s (LRU, %d bytes)",
                          victim_id, victim.nbytes)
+        if self.fabric is not None and entry.conversation_id in self._entries:
+            # the insert may itself have been LRU-evicted above
+            self.fabric.note(entry.conversation_id, self.fabric_replica,
+                             entry.n_tokens)
         self._publish_gauges()
         return True
 
@@ -769,6 +781,17 @@ class SessionKVCache:
         no longer routes to."""
         if self.disk is not None:
             self.disk.discard(conversation_id)
+        entry = self._entries.pop(conversation_id, None)
+        if entry is not None:
+            self._drop(entry)
+            self._publish_gauges()
+
+    def drop_local(self, conversation_id: str) -> None:
+        """Drop the RAM copy ONLY — the fabric-migration counterpart of
+        ``discard``: the bytes just moved to another replica whose put
+        wrote through to the SHARED tier, so deleting the disk record
+        here would erase the record the target just refreshed (the two
+        ride the same single writer queue)."""
         entry = self._entries.pop(conversation_id, None)
         if entry is not None:
             self._drop(entry)
@@ -799,6 +822,10 @@ class SessionKVCache:
         entry.snap = None
         if self._on_drop is not None:
             self._on_drop(entry)
+        if self.fabric is not None:
+            # holder-guarded: a migration target that already noted its
+            # fresher copy keeps its claim when the source drops here
+            self.fabric.forget(entry.conversation_id, self.fabric_replica)
 
     # --- disk tier (ISSUE 7) ---------------------------------------------
     def _spill(self, entry: SessionEntry) -> bool:

@@ -1,0 +1,179 @@
+"""Cluster-wide warm-state fabric (ISSUE 17; ROBUSTNESS.md §6).
+
+The fleet's warm state — retired conversations' KV snapshots and the
+shared prompt heads' KV — was per-replica until now: each replica had its
+own ``SessionDiskTier`` subdirectory, each re-prefilled the system prompt
+on its own device state, and route-time migration discovered a sibling's
+deeper entry by scanning every replica pairwise. At the north-star scale
+(millions of mostly-idle conversations over a handful of replicas) that
+triples the cold-start surface for no reason: the bytes are
+device-independent by construction (``export_entry`` is the wire format).
+
+``WarmFabric`` makes warm state a FLEET resource:
+
+- **Shared backing store**: ONE ``SessionDiskTier`` instance (one
+  directory, one write-behind worker — so there are no cross-process file
+  races to reason about) replaces the per-replica subdirectories. Every
+  replica's session cache writes through to it and restores from it, so
+  ANY replica resumes ANY conversation warm via the ordinary
+  RAM-miss → disk-restore admission path, even if it never saw the
+  conversation. Its durability metrics label as ``replica="fabric"``.
+- **Global RAM index**: ``note``/``forget``/``holder`` track which
+  replica's host-RAM cache holds each session key and how deep. The
+  route-time deeper-entry-wins migration (``serve/fleet.py``) becomes an
+  O(1) index lookup instead of an O(replicas) pairwise scan, and the
+  source's RAM copy is dropped WITHOUT deleting the shared record the
+  target just refreshed (``SessionKVCache.drop_local``).
+- **Shared prompt heads**: the first replica to prefill a registered
+  prefix head snapshots its pages (``engine.offload_pages``) into the
+  fabric, keyed by a hash of the head's rendered bytes (the token ids ARE
+  the deterministic tokenization of ``render_chat_prefix``'s output, so
+  hashing their bytes keys the rendered prefix). Every later registration
+  of the same head — sibling replicas at boot, respawned replicas
+  re-registering after a rebuild — restores the pages with one H2D
+  scatter (``engine.restore_pages``) instead of re-running the prefill.
+  The system-prompt prefill is paid once per FLEET, not once per replica
+  per rebuild.
+
+Hit/miss/refusal accounting lives with the CALLERS (scheduler
+``register_prefix`` / ``_restore_session_from_disk``) on their per-replica
+labeled metrics views — the fabric itself is passive storage, and a
+cross-mode record refused by the tier at load additionally counts on the
+tier's own ``finchat_quant_dequant_fallbacks_total{replica="fabric"}``.
+
+Head snapshots populate on the SYNC ``register_prefix`` path (startup
+registration and respawn re-registration both land there after a fabric
+miss); the chunked ``register_prefix_async`` path restores from the
+fabric when it can but never writes it — its job machinery retires pages
+incrementally and a partial snapshot would be garbage.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+from finchat_tpu.engine.session_cache import SessionDiskTier
+from finchat_tpu.utils.logging import get_logger
+from finchat_tpu.utils.metrics import METRICS
+
+logger = get_logger(__name__)
+
+# disk-record key prefix for shared-head snapshots: namespaced away from
+# conversation ids (which are user-derived and could otherwise collide)
+_HEAD_NS = "__fabric_head__"
+
+# RAM-cached head snapshots kept per process (LRU): heads are a handful of
+# pages each and a fleet registers a handful of heads, so this is a small
+# bound against pathological churn, not a real budget
+_HEAD_RAM_CAP = 32
+
+
+def head_key(ids: list[int]) -> str:
+    """Stable fabric key for a shared prompt head: hash of the head's
+    token bytes. The ids are the deterministic tokenization of the
+    rendered chat prefix (``render_chat_prefix``), so equal rendered
+    bytes ⇒ equal ids ⇒ equal key, across replicas and restarts."""
+    raw = np.asarray(ids, np.int32).tobytes()
+    return _HEAD_NS + hashlib.sha1(raw).hexdigest()
+
+
+class WarmFabric:
+    """One per process; every replica's scheduler holds a reference."""
+
+    def __init__(self, path: str, budget_bytes: int, kv_quant: str = ""):
+        # the ONE shared disk tier: all replicas spill to / restore from it
+        self.tier = SessionDiskTier(
+            path, budget_bytes,
+            metrics=METRICS.labeled(replica="fabric"),
+            kv_quant=kv_quant,
+        )
+        # session key -> (replica_id, n_tokens): which replica's host-RAM
+        # cache holds the key, and how deep. Maintained by SessionKVCache
+        # put/drop hooks; read by the fleet router's migration lookup.
+        self._index: dict[str, tuple[str, int]] = {}
+        # head key -> snapshot tuple (host page arrays, offload_pages shape)
+        self._heads: OrderedDict[str, tuple] = OrderedDict()
+        # replicas share one asyncio loop, but disk-writer and breaker
+        # rebuild threads exist — cheap lock, never held across I/O
+        self._lock = threading.Lock()
+
+    # --- session index ---------------------------------------------------
+    def note(self, key: str, replica_id: str | None, n_tokens: int) -> None:
+        """Record that ``replica_id``'s RAM cache now holds ``key`` at
+        ``n_tokens`` depth (last writer wins — puts replace)."""
+        if replica_id is None:
+            return
+        with self._lock:
+            self._index[key] = (replica_id, int(n_tokens))
+
+    def forget(self, key: str, replica_id: str | None) -> None:
+        """Clear ``key``'s index entry IF ``replica_id`` still holds it —
+        holder-guarded so a source replica's post-migration drop cannot
+        erase the target's fresher claim (the target's put noted first)."""
+        with self._lock:
+            cur = self._index.get(key)
+            if cur is not None and cur[0] == replica_id:
+                del self._index[key]
+
+    def holder(self, key: str) -> tuple[str, int] | None:
+        """(replica_id, n_tokens) of the RAM holder, or None."""
+        with self._lock:
+            return self._index.get(key)
+
+    # --- shared prompt heads ---------------------------------------------
+    def load_head(self, ids: list[int]) -> tuple | None:
+        """The head's host KV snapshot, or None (fabric miss). RAM first;
+        a disk record is verified to carry exactly these token ids (hash
+        collision / truncated-record guard) before its snapshot is
+        trusted. Cross-mode disk records are refused by the tier itself
+        (counted there); the caller still mode-checks RAM hits."""
+        key = head_key(ids)
+        with self._lock:
+            snap = self._heads.get(key)
+            if snap is not None:
+                self._heads.move_to_end(key)
+                return snap
+        if key not in self.tier:
+            return None
+        payload = self.tier.load(key)
+        if payload is None or payload["snap"] is None:
+            return None
+        if not np.array_equal(payload["token_ids"],
+                              np.asarray(ids, np.int32)):
+            logger.warning("warm fabric: head record %s carries different "
+                           "token ids; ignoring", key)
+            return None
+        snap = payload["snap"]
+        with self._lock:
+            self._heads[key] = snap
+            while len(self._heads) > _HEAD_RAM_CAP:
+                self._heads.popitem(last=False)
+        return snap
+
+    def store_head(self, ids: list[int], snap: tuple | None) -> None:
+        """Publish a freshly-prefilled head's snapshot fleet-wide: RAM for
+        in-process siblings, disk record (write-behind) for restarts and
+        any replica whose RAM copy ages out."""
+        if snap is None:
+            return
+        key = head_key(ids)
+        with self._lock:
+            self._heads[key] = snap
+            self._heads.move_to_end(key)
+            while len(self._heads) > _HEAD_RAM_CAP:
+                self._heads.popitem(last=False)
+        # prefix_len 0: the head snapshot IS the whole record (no nested
+        # shared head below it); gap fields 0 — heads are never bounded
+        # past the sink clamp _prefix_prep already applied to ``ids``
+        self.tier.spill(key, np.asarray(ids, np.int32), 0, snap)
+
+    # --- lifecycle -------------------------------------------------------
+    def flush(self) -> None:
+        self.tier.flush()
+
+    def close(self) -> None:
+        self.tier.close()

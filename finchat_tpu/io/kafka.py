@@ -58,7 +58,10 @@ def partition_for_key(key: str | None, num_partitions: int = DEFAULT_NUM_PARTITI
     replica routing is aligned with Kafka partition assignment BY
     CONSTRUCTION — every conversation of one partition routes to one
     replica, and a replica's routing share is exactly a set of partitions
-    a consumer-group assignment could mirror.
+    a consumer-group assignment could mirror. The disagg coordinator
+    (serve/disagg.py) reuses it a third time for prefill-POOL placement,
+    so a conversation's cold turns keep landing on the same prefill
+    replica and its shared-head/session state stays warm between turns.
 
     CAVEAT (confluent backend): CRC32 is librdkafka's ``consistent``
     partitioner, NOT the Java client's default (murmur2) — messages

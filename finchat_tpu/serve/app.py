@@ -348,13 +348,16 @@ def _load_model_artifacts(cfg: AppConfig) -> tuple:
 
 
 def make_engine_replica(
-    cfg: AppConfig, artifacts: tuple, replica_id: str | None = None
+    cfg: AppConfig, artifacts: tuple, replica_id: str | None = None,
+    fabric=None,
 ) -> tuple[EngineGenerator, ContinuousBatchingScheduler]:
     """One engine replica over the shared artifacts: its own KV page pool
     (InferenceEngine device state), scheduler, and session cache. A
     ``replica_id`` routes the scheduler's metrics through a labeled view
     (every metric family per replica) and stamps its fault-injection
-    sites."""
+    sites. ``fabric`` (engine/warm_fabric.py — ISSUE 17) makes the
+    replica's session tier the fleet-shared one and lets its shared
+    prompt heads restore from / publish to the cluster-wide store."""
     config, params, tokenizer, mesh = artifacts
     metrics = METRICS.labeled(replica=replica_id) if replica_id is not None else None
     engine = InferenceEngine(config, params, cfg.engine, mesh=mesh,
@@ -363,12 +366,33 @@ def make_engine_replica(
     if cfg.engine.warmup_on_start:
         engine.warmup()
     scheduler = ContinuousBatchingScheduler(
-        engine, eos_id=tokenizer.eos_id, metrics=metrics, replica_id=replica_id
+        engine, eos_id=tokenizer.eos_id, metrics=metrics,
+        replica_id=replica_id, fabric=fabric,
     )
     return EngineGenerator(scheduler, tokenizer), scheduler
 
 
-def build_generators(cfg: AppConfig) -> tuple[TextGenerator, TextGenerator, ContinuousBatchingScheduler | None, object]:
+def make_warm_fabric(cfg: AppConfig):
+    """The process's warm-state fabric per config, or None. Best-effort:
+    an unusable path logs and serves without the fabric rather than
+    failing assembly (the per-replica PR 7 layout still applies)."""
+    if not (cfg.fabric.enabled and cfg.fabric.path):
+        if cfg.fabric.enabled:
+            logger.warning("fabric.enabled is set but fabric.path is empty; "
+                           "warm-state fabric stays off")
+        return None
+    from finchat_tpu.engine.warm_fabric import WarmFabric
+
+    try:
+        return WarmFabric(cfg.fabric.path, cfg.engine.session_cache_disk_bytes,
+                          kv_quant=cfg.engine.kv_quant)
+    except Exception as e:
+        logger.error("warm-state fabric unavailable at %s: %s",
+                     cfg.fabric.path, e)
+        return None
+
+
+def build_generators(cfg: AppConfig, fabric=None) -> tuple[TextGenerator, TextGenerator, ContinuousBatchingScheduler | None, object]:
     """Construct (tool_generator, response_generator, scheduler, tokenizer).
 
     ``model.preset == "stub"`` wires canned generators (dev/no-TPU); anything
@@ -379,7 +403,7 @@ def build_generators(cfg: AppConfig) -> tuple[TextGenerator, TextGenerator, Cont
         stub = StubGenerator(default="I'm Penny, here to help with your finances.")
         return stub, stub, None, get_tokenizer()
     artifacts = _load_model_artifacts(cfg)
-    generator, scheduler = make_engine_replica(cfg, artifacts)
+    generator, scheduler = make_engine_replica(cfg, artifacts, fabric=fabric)
     return generator, generator, scheduler, artifacts[2]
 
 
@@ -1264,23 +1288,36 @@ def build_app(cfg: AppConfig | None = None, *, store: ConversationStore | None =
     tokenizer = None
     fleet_replicas: list[EngineReplica] | None = None
     if tool_generator is None or response_generator is None:
+        # cluster-wide warm-state fabric (ISSUE 17): one shared session
+        # disk tier + head store for every replica built below (a single-
+        # engine worker uses it too — restarts and multi-process fleets
+        # sharing the path resume each other's conversations warm)
+        fabric = make_warm_fabric(cfg) if cfg.model.preset != "stub" else None
         if cfg.fleet.replicas > 1 and cfg.model.preset != "stub":
             # engine fleet (ISSUE 6): N replicas over ONE shared weights
             # tree, each with its own KV pool, scheduler, session cache,
-            # and replica-labeled metrics; agents bind per replica below
+            # and replica-labeled metrics; agents bind per replica below.
+            # fleet.roles (ISSUE 17) types each replica into the prefill
+            # or serving pool; EngineFleet wires the disagg coordinator.
+            from finchat_tpu.serve.disagg import parse_roles
+
+            roles = parse_roles(cfg.fleet.roles, cfg.fleet.replicas)
             artifacts = _load_model_artifacts(cfg)
             tokenizer = artifacts[2]
             fleet_replicas = []
             for i in range(cfg.fleet.replicas):
-                gen, sched = make_engine_replica(cfg, artifacts, replica_id=str(i))
+                gen, sched = make_engine_replica(cfg, artifacts,
+                                                 replica_id=str(i),
+                                                 fabric=fabric)
                 fleet_replicas.append(
-                    EngineReplica(replica_id=str(i), scheduler=sched, generator=gen)
+                    EngineReplica(replica_id=str(i), scheduler=sched,
+                                  generator=gen, role=roles[i])
                 )
             scheduler = fleet_replicas[0].scheduler
             tool_generator = tool_generator or fleet_replicas[0].generator
             response_generator = response_generator or fleet_replicas[0].generator
         else:
-            tool_gen, resp_gen, scheduler, tokenizer = build_generators(cfg)
+            tool_gen, resp_gen, scheduler, tokenizer = build_generators(cfg, fabric=fabric)
             tool_generator = tool_generator or tool_gen
             response_generator = response_generator or resp_gen
 

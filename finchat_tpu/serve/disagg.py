@@ -1,0 +1,243 @@
+"""Disaggregated prefill/decode serving (ISSUE 17; ROBUSTNESS.md §6).
+
+Prefill and decode have opposite resource shapes: prefill is a compute
+burst that monopolizes the device for whole chunks, decode is a steady
+trickle of small steps whose latency users feel per token. On a mixed
+replica a long-prompt arrival stalls every in-flight stream for the
+duration of its chunks. Role-typed pools split the two:
+
+- ``fleet.roles`` assigns each replica ``prefill`` / ``decode`` /
+  ``mixed``. The router's rendezvous hash runs over the SERVING pool
+  (decode + mixed) only — prefill replicas never own conversations.
+- When a serving replica admits a turn whose cold residue (prompt tokens
+  not covered by a shared head, its RAM session entry, or a disk record)
+  is at least one prefill chunk, the ``DisaggCoordinator`` first runs the
+  prompt to completion on a prefill-pool replica (chunked, overlap- and
+  ring-capable — it is an ordinary scheduler submission with
+  ``max_new_tokens=1``), then hands the surviving KV to the serving
+  replica over the EXISTING drain-handoff wire format
+  (``export_session`` → ``import_session_entry``; ``kv_gap``/``kv_sink``
+  travel, shared heads re-link against the importer's own registration).
+  The handoff is a turn-start session migration — byte-identical by
+  construction, same as a fleet drain.
+- **Clean fallback**: an empty/drained/tripped prefill pool, a prefill
+  error (including a breaker trip racing the pass — the tripped
+  replica's drain sink may even deliver the bytes itself), or a refused
+  import all just mean the serving replica prefills locally, exactly the
+  mixed-serving behavior. Every fallback is counted by reason on
+  ``finchat_disagg_fallbacks_total``.
+
+The coordinator is attached (by ``EngineFleet``) ONLY to serving-pool
+schedulers, so a prefill replica's own submissions can never recurse.
+Prefill-pool placement reuses ``io/kafka.py partition_for_key`` — the
+same CRC32 the broker and the router already use — so a conversation's
+cold turns keep hitting the same prefill replica and its shared-head /
+session state stays warm there between turns.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from finchat_tpu.engine.sampler import SamplingParams
+from finchat_tpu.io.kafka import partition_for_key
+from finchat_tpu.utils.logging import get_logger
+from finchat_tpu.utils.tracing import TRACER
+
+logger = get_logger(__name__)
+
+# replica pool roles (EngineReplica.role)
+ROLE_PREFILL = "prefill"
+ROLE_DECODE = "decode"
+ROLE_MIXED = "mixed"
+ROLES = (ROLE_PREFILL, ROLE_DECODE, ROLE_MIXED)
+
+# finchat_disagg_fallbacks_total reasons, pre-seeded per replica (R5):
+# no_prefill_replica — prefill pool empty, drained, or all tripped
+# prefill_error      — the prefill pass failed/produced nothing to export
+# import_refused     — the serving replica refused the exported entry
+# serving_pool_empty — every decode/mixed replica down: a prefill replica
+#                      absorbed the routed message itself (serve/fleet.py)
+FALLBACK_REASONS = ("no_prefill_replica", "prefill_error", "import_refused",
+                    "serving_pool_empty")
+
+
+def parse_roles(spec: str, n: int) -> list[str]:
+    """``fleet.roles`` ("prefill,decode,decode,mixed") → one role per
+    replica. Empty spec, or a spec that would leave NO serving replica
+    (all prefill — a misconfiguration that could route nothing), falls
+    back to all-mixed with a loud log. A short spec pads with mixed; a
+    long one truncates."""
+    if not spec.strip():
+        return [ROLE_MIXED] * n
+    roles = [r.strip().lower() or ROLE_MIXED for r in spec.split(",")]
+    for r in roles:
+        if r not in ROLES:
+            raise ValueError(
+                f"fleet.roles: unknown role {r!r} (expected one of {ROLES})"
+            )
+    roles = (roles + [ROLE_MIXED] * n)[:n]
+    if all(r == ROLE_PREFILL for r in roles):
+        logger.error("fleet.roles=%r leaves no serving replica; "
+                     "running all replicas mixed instead", spec)
+        return [ROLE_MIXED] * n
+    return roles
+
+
+class DisaggCoordinator:
+    """Per-fleet: runs cold prompts on the prefill pool and hands the KV
+    to the submitting serving replica before admission."""
+
+    def __init__(self, fleet):
+        self.fleet = fleet
+        # conversation keys with a prefill pass in flight: a second turn
+        # submitted concurrently proceeds without its own handoff rather
+        # than duplicating the prefill work
+        self._inflight: set[str] = set()
+        self._n_passes = 0
+
+    # --- pool views ------------------------------------------------------
+    def prefill_pool(self) -> list:
+        """Live, non-gave-up prefill replicas (drained/tripped excluded)."""
+        return [
+            r for r in self.fleet.live_replicas()
+            if getattr(r, "role", ROLE_MIXED) == ROLE_PREFILL
+            and not getattr(r.scheduler, "gave_up", False)
+        ]
+
+    # --- the handoff -----------------------------------------------------
+    def _cold_residue(self, sched, conversation_id: str,
+                      prompt_ids: list[int]) -> int:
+        """Prompt tokens the serving replica would prefill COLD: total
+        minus the last token (which decodes, never prefills warm) minus
+        the deepest page-floored coverage from its shared heads and its
+        session tiers. A disk/fabric record counts as full coverage —
+        admission restores it locally and a handoff would be pure waste."""
+        page = sched.engine.page_size
+        _entry, covered = sched._match_prefix(prompt_ids)
+        cache = sched.session_cache
+        if cache is not None:
+            if cache.get(conversation_id) is None:
+                if cache.disk is not None and conversation_id in cache.disk:
+                    return 0
+            else:
+                e = cache.get(conversation_id)
+                m = min(e.n_tokens, len(prompt_ids) - 1)
+                a = np.asarray(e.token_ids[:m], np.int32)
+                b = np.asarray(prompt_ids[:m], np.int32)
+                neq = np.nonzero(a != b)[0]
+                common = int(neq[0]) if neq.size else m
+                covered = max(covered, (common // page) * page)
+        return len(prompt_ids) - 1 - covered
+
+    async def maybe_prefill(self, sched, prompt_ids: list[int],
+                            conversation_id: str,
+                            trace_id: str | None = None) -> None:
+        """Called by a serving scheduler's ``submit`` before admission.
+        Best-effort by contract: every early return leaves the caller on
+        the plain (mixed) path; nothing here may raise into submit."""
+        if sched.session_cache is None:
+            return  # no session tier = no wire format for the handoff
+        residue = self._cold_residue(sched, conversation_id, prompt_ids)
+        if residue < sched.engine.engine_cfg.prefill_chunk:
+            return  # under one chunk of cold work: local prefill is fine
+        metrics = sched.metrics
+        pool = self.prefill_pool()
+        if not pool:
+            metrics.inc("finchat_disagg_fallbacks_total",
+                        labels={"reason": "no_prefill_replica"})
+            return
+        if conversation_id in self._inflight:
+            return
+        self._inflight.add(conversation_id)
+        t0 = time.perf_counter()
+        try:
+            rep = pool[partition_for_key(conversation_id, len(pool))]
+            if rep.scheduler is sched:  # misconfigured double-attachment
+                return
+            payload = await self._prefill_pass(rep, prompt_ids,
+                                               conversation_id, trace_id)
+            if payload is None:
+                metrics.inc("finchat_disagg_fallbacks_total",
+                            labels={"reason": "prefill_error"})
+                return
+            # the existing drain-handoff wire format: cross-mode snapshots
+            # and head-relink failures are refused (and counted) inside
+            # import_session_entry itself
+            try:
+                ok = sched.import_session_entry(payload)
+            except Exception as e:
+                logger.error("disagg: import into %s failed for %s: %s",
+                             sched.replica_id, conversation_id, e)
+                ok = False
+            src = rep.scheduler.session_cache
+            if src is not None:
+                if ok and src.fabric is not None:
+                    # shared tier: the target's put just refreshed the
+                    # record — drop only the source's RAM copy
+                    src.drop_local(conversation_id)
+                else:
+                    src.discard(conversation_id)
+            if not ok:
+                metrics.inc("finchat_disagg_fallbacks_total",
+                            labels={"reason": "import_refused"})
+                return
+            metrics.inc("finchat_disagg_handoffs_total")
+            metrics.observe("finchat_disagg_handoff_seconds",
+                            time.perf_counter() - t0)
+            if TRACER.enabled:
+                TRACER.event("disagg_handoff", trace_id, track="fleet",
+                             args={"source": rep.replica_id,
+                                   "target": sched.replica_id,
+                                   "tokens": int(len(payload["token_ids"]))})
+            logger.info("disagg: prefilled %s on %s, handed %d tokens to %s",
+                        conversation_id, rep.replica_id,
+                        len(payload["token_ids"]), sched.replica_id)
+        except Exception as e:
+            logger.error("disagg: handoff for %s failed: %s",
+                         conversation_id, e)
+            metrics.inc("finchat_disagg_fallbacks_total",
+                        labels={"reason": "prefill_error"})
+        finally:
+            self._inflight.discard(conversation_id)
+
+    async def _prefill_pass(self, rep, prompt_ids: list[int],
+                            conversation_id: str,
+                            trace_id: str | None) -> dict | None:
+        """Run the prompt to completion on the prefill replica and export
+        the retired session entry. An ordinary greedy submission with
+        ``max_new_tokens=1``: retirement's ``_maybe_offload`` snapshots
+        every page-whole prompt token into the replica's session cache
+        (the one generated token rides past the page floor and is cut by
+        the importer's divergence truncation on the real turn). The pass
+        gets all of the prefill path's machinery for free — chunking,
+        overlap coexistence, ring routing, bounded-KV eviction."""
+        self._n_passes += 1
+        psched = rep.scheduler
+        try:
+            handle = await psched.submit(
+                f"__disagg_{self._n_passes}__", list(prompt_ids),
+                SamplingParams(temperature=0.0, max_new_tokens=1),
+                conversation_id=conversation_id, trace_id=trace_id,
+            )
+        except Exception as e:  # backpressure / length bound on the pool
+            logger.warning("disagg: prefill submit on %s refused: %s",
+                           rep.replica_id, e)
+            return None
+        while True:
+            ev = await handle.events.get()
+            if ev["type"] == "error":
+                # a breaker trip mid-pass may have drained the handle to a
+                # serving sibling — in that case the session bytes already
+                # moved with it and the export below finds nothing, which
+                # the caller counts as a fallback; the turn still serves
+                logger.warning("disagg: prefill pass for %s errored: %s",
+                               conversation_id, ev.get("message"))
+                break
+            if ev["type"] == "done":
+                break
+        if psched.session_cache is None:
+            return None
+        return psched.export_session(conversation_id)

@@ -66,6 +66,13 @@ from finchat_tpu.engine.session_cache import (
     session_key,
 )
 from finchat_tpu.io.kafka import DEFAULT_NUM_PARTITIONS, partition_for_key
+from finchat_tpu.serve.disagg import (
+    FALLBACK_REASONS,
+    ROLE_DECODE,
+    ROLE_MIXED,
+    ROLE_PREFILL,
+    DisaggCoordinator,
+)
 from finchat_tpu.utils.config import FleetConfig
 from finchat_tpu.utils.logging import get_logger
 from finchat_tpu.utils.metrics import METRICS
@@ -159,6 +166,13 @@ class EngineReplica:
     agent: Any = None
     state: str = LIVE
     registered_heads: set = field(default_factory=set)
+    # pool role (serve/disagg.py — ISSUE 17): ``prefill`` replicas never
+    # own conversations (the router hashes over decode+mixed only); they
+    # run cold prompts for the serving pool and hand the KV over the
+    # drain-handoff wire format. Lifecycle (drain, OUT, respawn) is
+    # role-blind — a tripped prefill replica drains to the serving pool
+    # like any sibling.
+    role: str = ROLE_MIXED
 
 
 class EngineFleet:
@@ -196,6 +210,23 @@ class EngineFleet:
         self.on_respawn: list[Callable[[EngineReplica], Any]] = []
         for rep in self.replicas:
             self._wire(rep)
+        # disaggregated serving (ISSUE 17): with any prefill-role replica,
+        # serving-pool schedulers route cold prompt prefills through the
+        # coordinator. All-prefill is a misconfiguration that could serve
+        # nothing — demote to all-mixed loudly instead.
+        self.disagg: DisaggCoordinator | None = None
+        if all(r.role == ROLE_PREFILL for r in self.replicas):
+            logger.error("fleet: every replica has role=prefill — no "
+                         "serving pool; running all replicas mixed")
+            for rep in self.replicas:
+                rep.role = ROLE_MIXED
+        if any(r.role == ROLE_PREFILL for r in self.replicas):
+            self.disagg = DisaggCoordinator(self)
+            for rep in self.replicas:
+                if rep.role != ROLE_PREFILL:
+                    rep.scheduler.disagg = self.disagg
+        for rep in self.replicas:
+            self._seed_disagg_metrics(rep)
         self._publish_live_gauge()
 
     # --- wiring ---------------------------------------------------------
@@ -204,6 +235,20 @@ class EngineFleet:
         if self.cfg.drain_on_trip and len(self.replicas) > 1:
             sched.drain_sink = self._make_drain_sink(rep)
         sched.on_give_up.append(lambda rep=rep: self._mark_out(rep))
+
+    def _seed_disagg_metrics(self, rep: EngineReplica) -> None:
+        """Per-replica disagg families at zero (R5: the quiet state is
+        visible, and the role gauge says which pool a series belongs to).
+        Skipped for test stubs without a metrics view."""
+        m = getattr(rep.scheduler, "metrics", None)
+        if m is None:
+            return
+        m.set_gauge("finchat_disagg_role",
+                    {ROLE_MIXED: 0, ROLE_PREFILL: 1, ROLE_DECODE: 2}[rep.role])
+        m.inc("finchat_disagg_handoffs_total", 0.0)
+        for reason in FALLBACK_REASONS:
+            m.inc("finchat_disagg_fallbacks_total", 0.0,
+                  labels={"reason": reason})
 
     def _publish_live_gauge(self) -> None:
         self.metrics.set_gauge(
@@ -222,17 +267,38 @@ class EngineFleet:
     def live_replicas(self) -> list[EngineReplica]:
         return [r for r in self.replicas if r.state == LIVE]
 
+    def serving_replicas(self) -> list[EngineReplica]:
+        """The pool conversations route over: live decode/mixed replicas.
+        An empty serving pool (every decode replica drained or tripped)
+        falls back to ALL live replicas — a prefill replica serving
+        decode beats shedding, and the fallback is counted per message
+        on the chosen replica (ISSUE 17 clean-fallback contract)."""
+        live = self.live_replicas()
+        pool = [r for r in live if r.role != ROLE_PREFILL]
+        return pool if pool else live
+
     def partition_for(self, conversation_id: str) -> int:
         return partition_for_key(conversation_id, self.num_partitions)
 
     def replica_for_partition(self, partition: int,
                               exclude: EngineReplica | None = None) -> EngineReplica | None:
-        """The live replica owning a Kafka partition — THE routing unit,
-        so every conversation of one partition routes together and the
-        assignment is expressible as a partition→replica map."""
-        ids = [r.replica_id for r in self.live_replicas() if r is not exclude]
+        """The live serving replica owning a Kafka partition — THE routing
+        unit, so every conversation of one partition routes together and
+        the assignment is expressible as a partition→replica map."""
+        pool = self.serving_replicas()
+        ids = [r.replica_id for r in pool if r is not exclude]
         rid = rendezvous_hash(str(partition), ids)
-        return self._by_id[rid] if rid is not None else None
+        if rid is None:
+            return None
+        target = self._by_id[rid]
+        if target.role == ROLE_PREFILL:
+            # serving-pool-empty fallback engaged: counted on the replica
+            # actually absorbing the decode load, per message
+            m = getattr(target.scheduler, "metrics", None)
+            if m is not None:
+                m.inc("finchat_disagg_fallbacks_total",
+                      labels={"reason": "serving_pool_empty"})
+        return target
 
     def replica_for(self, conversation_id: str,
                     exclude: EngineReplica | None = None) -> EngineReplica | None:
@@ -282,8 +348,27 @@ class EngineFleet:
             self._migrate_key(session_key(conversation_id, role), target)
 
     def _migrate_key(self, key: str, target: EngineReplica) -> None:
-        have = target.scheduler.session_cache.get(key)
+        t_cache = target.scheduler.session_cache
+        have = t_cache.get(key)
         have_n = have.n_tokens if have is not None else 0
+        fabric = getattr(t_cache, "fabric", None)
+        if fabric is not None:
+            # warm-state fabric (ISSUE 17): deeper-entry-wins is an O(1)
+            # index lookup — the fabric knows which replica's RAM holds
+            # the key and how deep. No holder (or only a shallower one)
+            # means nothing to move: the SHARED disk tier already serves
+            # any replica's record at admission, so the pairwise scan's
+            # other job — finding disk-only bytes — is moot by design.
+            hold = fabric.holder(key)
+            if hold is None:
+                return
+            rid, n_tokens = hold
+            if rid == target.replica_id or n_tokens <= have_n:
+                return
+            rep = self._by_id.get(rid)
+            if rep is not None and rep is not target:
+                self._move_entry(rep, target, key)
+            return
         for rep in self.replicas:
             if rep is target:
                 continue
@@ -293,29 +378,45 @@ class EngineFleet:
             entry = s_cache.get(key)
             if entry is None or entry.n_tokens <= have_n:
                 continue
-            payload = rep.scheduler.export_session(key)
-            if payload is None:
-                continue
-            try:
-                imported = target.scheduler.import_session_entry(payload)
-            except Exception as e:
-                logger.error("session migration %s→%s failed for %s: %s",
-                             rep.replica_id, target.replica_id, key, e)
-                continue
+            if self._move_entry(rep, target, key) is not None:
+                return
+
+    def _move_entry(self, rep: EngineReplica, target: EngineReplica,
+                    key: str) -> bool | None:
+        """Export ``key`` from ``rep``'s RAM cache into ``target``'s
+        (the one migration wire format). Returns the import verdict, or
+        None when there was nothing to export (the caller may keep
+        scanning)."""
+        payload = rep.scheduler.export_session(key)
+        if payload is None:
+            return None
+        try:
+            imported = target.scheduler.import_session_entry(payload)
+        except Exception as e:
+            logger.error("session migration %s→%s failed for %s: %s",
+                         rep.replica_id, target.replica_id, key, e)
+            return False
+        s_cache = rep.scheduler.session_cache
+        if imported and s_cache.fabric is not None:
+            # shared tier: the target's put just refreshed the record —
+            # deleting it here would erase the target's own disk twin
+            # (both ride the one writer queue); drop only the RAM copy
+            s_cache.drop_local(key)
+        else:
             # the source copy goes either way: a stale twin left behind
             # could serve diverged KV if routing ever flips back
             s_cache.discard(key)
-            if imported:
-                self.metrics.inc("finchat_fleet_session_migrations_total")
-                if TRACER.enabled:
-                    TRACER.event("session_migrate", track="fleet",
-                                 args={"key": key,
-                                       "source": rep.replica_id,
-                                       "target": target.replica_id})
-                logger.info("fleet: migrated session %s %s→%s (%d tokens)",
-                            key, rep.replica_id, target.replica_id,
-                            payload["token_ids"].shape[0])
-            return
+        if imported:
+            self.metrics.inc("finchat_fleet_session_migrations_total")
+            if TRACER.enabled:
+                TRACER.event("session_migrate", track="fleet",
+                             args={"key": key,
+                                   "source": rep.replica_id,
+                                   "target": target.replica_id})
+            logger.info("fleet: migrated session %s %s→%s (%d tokens)",
+                        key, rep.replica_id, target.replica_id,
+                        payload["token_ids"].shape[0])
+        return imported
 
     # --- drain ----------------------------------------------------------
     def _make_drain_sink(self, source: EngineReplica):

@@ -449,6 +449,32 @@ class FleetConfig:
     respawn: bool = True
     respawn_backoff_seconds: float = 0.5
     supervisor_interval_seconds: float = 0.2
+    # disaggregated serving (serve/disagg.py — ISSUE 17): comma-separated
+    # per-replica roles, e.g. "prefill,decode,decode" — ``prefill``
+    # replicas never own conversations (the router hashes over the
+    # decode+mixed serving pool only); a serving replica routes each cold
+    # turn's prompt prefill to the prefill pool and adopts the KV over the
+    # drain-handoff wire format. "" = every replica ``mixed`` (the PR 6
+    # behavior); a short list pads with ``mixed``. Also FINCHAT_FLEET_ROLES,
+    # CLI --fleet-roles.
+    roles: str = ""
+
+
+@dataclass
+class FabricConfig:
+    """Cluster-wide warm-state fabric (engine/warm_fabric.py — ISSUE 17).
+
+    With ``enabled`` and a ``path``, every replica's session cache shares
+    ONE disk tier (instead of per-replica subdirectories) and a global
+    RAM index, so any replica resumes any conversation warm and the
+    shared prompt heads' prefill is paid once per fleet — later replicas
+    and respawns restore the head KV from the fabric with one H2D
+    scatter. The tier's byte budget reuses
+    ``engine.session_cache_disk_bytes``.
+    """
+
+    enabled: bool = False  # FINCHAT_FABRIC
+    path: str = ""  # fabric directory; also FINCHAT_FABRIC_PATH, CLI --fabric-path
 
 
 @dataclass
@@ -519,6 +545,7 @@ class AppConfig:
     engine: EngineConfig = field(default_factory=EngineConfig)
     embed: EmbedConfig = field(default_factory=EmbedConfig)
     fleet: FleetConfig = field(default_factory=FleetConfig)
+    fabric: FabricConfig = field(default_factory=FabricConfig)
     journal: JournalConfig = field(default_factory=JournalConfig)
     shutdown: ShutdownConfig = field(default_factory=ShutdownConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
@@ -716,6 +743,9 @@ def load_config(
         "FINCHAT_FLEET_DRAIN_ON_TRIP", cfg.fleet.drain_on_trip
     )
     cfg.fleet.respawn = _env_bool("FINCHAT_FLEET_RESPAWN", cfg.fleet.respawn)
+    cfg.fleet.roles = _env("FINCHAT_FLEET_ROLES", cfg.fleet.roles)
+    cfg.fabric.enabled = _env_bool("FINCHAT_FABRIC", cfg.fabric.enabled)
+    cfg.fabric.path = _env("FINCHAT_FABRIC_PATH", cfg.fabric.path)
     cfg.serve.port = _env_int("FINCHAT_PORT", cfg.serve.port)
 
     # --- optional JSON config file ---

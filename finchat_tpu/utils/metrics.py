@@ -140,6 +140,27 @@ execution retried on the serial path), and the
 ``finchat_tool_overlap_saved_seconds`` histogram (per adopted launch,
 the slice of tool execution that ran under the remainder of the
 decision decode — the latency a serial decide→execute turn pays on top).
+
+Disaggregated-serving family (serve/disagg.py — ISSUE 17; per replica via
+the scheduler's labeled view): ``finchat_disagg_role`` (gauge — 0 mixed,
+1 prefill, 2 decode: the pool the replica serves in),
+``finchat_disagg_handoffs_total`` (cold prompts prefilled on the prefill
+pool and imported by a serving replica, counted on the importer),
+``finchat_disagg_fallbacks_total{reason=no_prefill_replica|prefill_error|
+import_refused|serving_pool_empty}`` (turns that fell back to mixed-style
+local prefill, per reason — pre-seeded at zero), and the
+``finchat_disagg_handoff_seconds`` histogram (prefill-pool submit →
+imported on the serving replica, the full handoff detour).
+
+Warm-fabric family (engine/warm_fabric.py — ISSUE 17; per replica, with
+the shared disk tier itself observing its durability family under
+``replica="fabric"``): ``finchat_fabric_hits_total`` /
+``finchat_fabric_misses_total`` (head-snapshot and session-record lookups
+against the cluster-wide fabric, counted on the requesting replica),
+``finchat_fabric_import_refused_total`` (fabric hit whose KV snapshot
+mode mismatched the engine — cold prefill instead), and the
+``finchat_fabric_restore_seconds`` histogram (fabric record → device KV,
+covering both shared-head restores and session resumes).
 """
 
 from __future__ import annotations

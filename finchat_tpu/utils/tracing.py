@@ -88,6 +88,14 @@ TRACE_EVENTS = frozenset({
     # count and the affected slots — page occupancy drops are attributable
     # on the timeline without any per-token cost
     "boundedkv_evict",
+    # disaggregated serving (ISSUE 17): a prefill-pool replica ran a cold
+    # prompt and handed its KV to the serving replica before admission —
+    # args carry source/target replicas and the token count
+    "disagg_handoff",
+    # warm-state fabric hit (ISSUE 17): a shared-head or session restore
+    # served from the cluster-wide fabric instead of a local prefill —
+    # args.kind distinguishes "head" from "session"
+    "fabric_hit",
 })
 
 #: Anomaly kinds — each records an event AND triggers a flight dump.
