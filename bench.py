@@ -199,6 +199,23 @@ def build_parser() -> argparse.ArgumentParser:
                    help="tiny --fleet-sweep variant for CI: same gates, "
                         "same drill (the drill IS the smoke — it is "
                         "CPU-sized already)")
+    p.add_argument("--pod-sweep", action="store_true",
+                   help="pod-scale multi-host drill (ISSUE 20): 2 simulated "
+                        "hosts x 2 replicas under the partition-assignment "
+                        "router with liaison heartbeats, the shared warm "
+                        "fabric, and per-partition journals; kill -9 one "
+                        "whole host mid-stream — goodput >= the surviving "
+                        "host's partition share during the detection gap "
+                        "and 1.0 after adoption, migrated conversations "
+                        "resume warm byte-identical (fabric record AND "
+                        "live-peer liaison pull both exercised), the "
+                        "adopted journals preload the dedupe ring (no "
+                        "double answer), and a no-liaison single-host "
+                        "control is byte-identical with zero pod-counter "
+                        "movement")
+    p.add_argument("--pod-smoke", action="store_true",
+                   help="tiny --pod-sweep variant for CI: same gates, "
+                        "smaller request waves")
     p.add_argument("--disagg-sweep", action="store_true",
                    help="disaggregated prefill/decode + warm-fabric drill "
                         "(ISSUE 17): a prefill storm against a 2+2 pool "
@@ -318,6 +335,8 @@ def run_worker(args: argparse.Namespace) -> int:
         result = measure_fleet_sweep(
             smoke=args.fleet_smoke, replicas=args.fleet_replicas
         )
+    elif args.pod_sweep or args.pod_smoke:
+        result = measure_pod_sweep(smoke=args.pod_smoke)
     elif args.disagg_sweep or args.disagg_smoke:
         result = measure_disagg_sweep(smoke=args.disagg_smoke)
     elif args.chaos_sweep or args.chaos_smoke:
@@ -3213,6 +3232,440 @@ def measure_fleet_sweep(smoke: bool = False, replicas: int = 4) -> dict:
     }
 
 
+def measure_pod_sweep(smoke: bool = False) -> dict:
+    """Pod-scale multi-host drill (ISSUE 20), CPU-runnable through REAL
+    schedulers on the tiny fp32 config: 2 simulated hosts x 2 replicas,
+    each host one Kafka consumer-group member (partition assignment IS
+    the cross-host routing table), liaison channels between them, the
+    warm-state fabric (ISSUE 17) as the shared disk tier, and one shared
+    per-partition journal directory. kill -9 one whole host mid-stream:
+
+    - the surviving host's streams COMPLETE BYTE-IDENTICAL to a clean
+      run, zero user-visible errors;
+    - goodput during the detection GAP (peer killed, death not yet
+      declared) >= the surviving host's partition share, and 1.0 once
+      the dead host's partitions are adopted;
+    - a conversation homed on the dead host resumes on the adopter
+      warm from the shared fabric record, byte-identical (and a second
+      conversation exercises the live-peer liaison pull path, also
+      byte-identical);
+    - the adopter replays exactly the inherited per-partition journals
+      into its dedupe ring — the dead host's already-answered id is a
+      duplicate on the adopter (no double answer after the kill);
+    - a no-liaison single-host control (pod attached, zero peers) is
+      byte-identical to the plain fleet and never touches a pod counter.
+    """
+    import asyncio
+    import dataclasses
+    import os
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from finchat_tpu.engine.engine import InferenceEngine
+    from finchat_tpu.engine.sampler import SamplingParams
+    from finchat_tpu.engine.scheduler import ContinuousBatchingScheduler
+    from finchat_tpu.engine.warm_fabric import WarmFabric
+    from finchat_tpu.io.journal import AnsweredJournal
+    from finchat_tpu.io.kafka import InMemoryBroker, KafkaClient
+    from finchat_tpu.models.llama import PRESETS, init_params
+    from finchat_tpu.serve.fleet import DedupeRing, EngineFleet, EngineReplica
+    from finchat_tpu.serve.pod import PEER_DEAD, PodCoordinator
+    from finchat_tpu.utils import faults
+    from finchat_tpu.utils.config import (
+        EngineConfig,
+        FleetConfig,
+        KafkaConfig,
+        PodConfig,
+    )
+    from finchat_tpu.utils.metrics import METRICS
+
+    config = dataclasses.replace(PRESETS["tiny"], dtype=jnp.float32)
+    params = init_params(config, jax.random.key(0))
+    PAGE, CHUNK = 8, 16
+    N_PARTS = 8
+    wave_n = 4 if smoke else 8
+    t1_prompt = list(range(1, 14))
+
+    def make_fleet(host_tag: str, fabric) -> EngineFleet:
+        reps = []
+        for i in range(2):
+            cfg = EngineConfig(
+                max_seqs=3, page_size=PAGE, num_pages=96, max_seq_len=256,
+                prefill_chunk=CHUNK, session_cache=True,
+                session_cache_bytes=32 << 20, breaker_max_rebuilds=1,
+            )
+            engine = InferenceEngine(config, params, cfg)
+            rid = f"{host_tag}{i}"
+            reps.append(EngineReplica(
+                replica_id=rid,
+                scheduler=ContinuousBatchingScheduler(
+                    engine, eos_id=-1,
+                    metrics=METRICS.labeled(replica=rid), replica_id=rid,
+                    fabric=fabric,
+                ),
+            ))
+        return EngineFleet(
+            reps,
+            FleetConfig(replicas=2, respawn_backoff_seconds=0.05,
+                        supervisor_interval_seconds=0.05),
+            num_partitions=32,
+        )
+
+    def pod_cfg(host: str, listen: str = "", peers: str = "") -> PodConfig:
+        return PodConfig(
+            host_id=host, listen=listen, peers=peers,
+            # the drill drives heartbeats by hand for determinism
+            heartbeat_interval_seconds=60.0, heartbeat_miss_threshold=2,
+            transfer_timeout_seconds=2.0, transfer_retries=1,
+            retry_backoff_seconds=0.0, breaker_threshold=3,
+            breaker_cooldown_seconds=0.05,
+        )
+
+    async def drain(handle):
+        tokens = []
+        while True:
+            ev = await handle.events.get()
+            if ev["type"] == "token":
+                tokens.append(ev["token_id"])
+            elif ev["type"] == "done":
+                return tokens, None
+            else:
+                return tokens, ev
+
+    greedy = lambda n: SamplingParams(temperature=0.0, max_new_tokens=n)  # noqa: E731
+    seq_counter = [0]
+
+    async def turn(fleet, conv, prompt, n_new=10):
+        seq_counter[0] += 1
+        rep = fleet.replica_for(conv)
+        h = await rep.scheduler.submit(
+            f"{conv}-t{seq_counter[0]}", prompt, greedy(n_new),
+            conversation_id=conv,
+        )
+        toks, err = await asyncio.wait_for(
+            asyncio.ensure_future(drain(h)), timeout=300)
+        return toks, err, h
+
+    async def scenario(chaos: bool, tag: str) -> dict:
+        out: dict = {"errors": 0}
+        base = tempfile.mkdtemp(prefix=f"finchat-pod-{tag}-")
+        broker = InMemoryBroker(num_partitions=N_PARTS)
+        ka = KafkaClient(KafkaConfig(num_partitions=N_PARTS), broker=broker)
+        kb = KafkaClient(KafkaConfig(num_partitions=N_PARTS), broker=broker)
+        # pin the member ids so the assignment (positional round-robin over
+        # the SORTED member list) — and with it every conversation's owner
+        # — is identical across the clean/chaos/control runs
+        ka._member_id, kb._member_id = "member-hostA", "member-hostB"
+        ka.setup_consumer()
+        kb.setup_consumer()
+        parts_a = {p for _t, p in ka.assignment()}
+        parts_b = {p for _t, p in kb.assignment()}
+        part_of = ka.partition_for
+        # ONE fabric tier: simulated pods in one process share the tier
+        # instance the way real hosts share the fabric directory
+        fabric = WarmFabric(os.path.join(base, "fabric"), 1 << 30)
+        jdir = os.path.join(base, "journal")
+        ja = AnsweredJournal(jdir, num_partitions=N_PARTS)
+        jb = AnsweredJournal(jdir, num_partitions=N_PARTS)
+        ring_a, ring_b = DedupeRing(256), DedupeRing(256)
+        fleet_a = make_fleet("a", fabric)
+        fleet_b = make_fleet("b", fabric)
+        coord_a = PodCoordinator(
+            pod_cfg("hostA", listen=f"inproc:{tag}-hostA",
+                    peers=f"hostB=inproc:{tag}-hostB"),
+            fleet=fleet_a, kafka=ka, journal=ja, dedupe=ring_a,
+        )
+        coord_b = PodCoordinator(
+            pod_cfg("hostB", listen=f"inproc:{tag}-hostB",
+                    peers=f"hostA=inproc:{tag}-hostA"),
+            fleet=fleet_b, kafka=kb, journal=jb, dedupe=ring_b,
+        )
+        for rep in fleet_a.replicas:
+            rep.scheduler.pod = coord_a
+        for rep in fleet_b.replicas:
+            rep.scheduler.pod = coord_b
+
+        def fleet_for(conv):
+            return fleet_a if part_of(conv) in parts_a else fleet_b
+
+        try:
+            await fleet_a.start()
+            await fleet_b.start()
+            await coord_a.start()
+            await coord_b.start()
+            peer_a = coord_b.peers["hostA"]
+            peer_b = coord_a.peers["hostB"]
+            # first heartbeat exchange: each side learns the other's Kafka
+            # member id (needed to evict the member on a death verdict)
+            await coord_b._heartbeat(peer_a)
+            await coord_a._heartbeat(peer_b)
+            assert peer_a.member_id == ka.member_id
+
+            # pmig: homed on host A — the fabric-migration conversation.
+            # lmig: owned by host B but SERVED by A (the pre-rebalance
+            # owner) — the liaison-pull conversation.
+            pmig = next(f"pm-{i}" for i in range(200)
+                        if part_of(f"pm-{i}") in parts_a)
+            lmig = next(f"lm-{i}" for i in range(200)
+                        if part_of(f"lm-{i}") in parts_b)
+            out["pmig"], out["lmig"] = pmig, lmig
+            out["pm1"], err, _ = await turn(fleet_a, pmig, t1_prompt)
+            assert err is None, err
+            out["lm1"], err, _ = await turn(fleet_a, lmig, t1_prompt)
+            assert err is None, err
+            # host A answered pmig: journal the id into its partition's
+            # file (fsync-before-commit), dedupe-ring it locally
+            ja.append(f"mid-{pmig}", partition=part_of(pmig))
+            ring_a.seen(f"mid-{pmig}")
+            # wait for the write-through records to land on the fabric
+            for _ in range(2000):
+                if pmig in fabric.tier and lmig in fabric.tier:
+                    break
+                await asyncio.sleep(0.005)
+            assert pmig in fabric.tier
+            # evict lmig's fabric record (stand-in for the tier's LRU):
+            # its only warm copy is now host A's RAM, so the cross-host
+            # turn below MUST come over the liaison
+            fabric.tier.discard(lmig)
+            await asyncio.to_thread(fabric.tier.flush)
+            assert lmig not in fabric.tier
+
+            # liaison migration while both hosts are live: lmig turn 2 on
+            # its real owner B pulls the session bytes from A's RAM
+            lm2_prompt = t1_prompt + out["lm1"] + [7, 8, 9]
+            out["lm2"], err, h = await turn(fleet_b, lmig, lm2_prompt)
+            out["errors"] += 1 if err is not None else 0
+            out["lm2_resumed"] = h.resumed_len
+
+            # in-flight streams, two per host, routed by partition owner
+            streams: dict[str, list] = {}
+            picked_a = picked_b = 0
+            i = 0
+            while picked_a < 2 or picked_b < 2:
+                conv = f"ps-{i}"
+                i += 1
+                on_a = part_of(conv) in parts_a
+                if on_a and picked_a < 2:
+                    picked_a += 1
+                elif not on_a and picked_b < 2:
+                    picked_b += 1
+                else:
+                    continue
+                streams[conv] = list(range(10 * i + 1, 10 * i + 15))
+            out["streams"] = streams
+            handles = {}
+            for conv, prompt in streams.items():
+                rep = fleet_for(conv).replica_for(conv)
+                handles[conv] = await rep.scheduler.submit(
+                    conv + "-s", prompt, greedy(10), conversation_id=conv)
+            tasks = {c: asyncio.create_task(drain(h))
+                     for c, h in handles.items()}
+
+            if chaos:
+                while any(h.generated < 2 for h in handles.values()):
+                    await asyncio.sleep(0.002)
+                # kill -9 the whole host: liaison off the wire with no
+                # goodbye, heartbeat task dead mid-flight
+                coord_a.kill()
+                # the GAP: host A's share is ownerless until the failure
+                # detector fires — only the survivor's share serves
+                gap_served = 0
+                gap_a = gap_b = 0
+                j = 0
+                while gap_a + gap_b < wave_n:
+                    conv = f"gap-{j}"
+                    j += 1
+                    if part_of(conv) in parts_a:
+                        if gap_a < wave_n // 2:
+                            gap_a += 1  # dead owner, no adopter yet: lost
+                        continue
+                    if gap_b >= wave_n - wave_n // 2:
+                        continue
+                    gap_b += 1
+                    _toks, e, _h = await turn(fleet_b, conv,
+                                              list(range(60 + j, 74 + j)),
+                                              n_new=6)
+                    gap_served += 1 if e is None else 0
+                out["goodput_during"] = gap_served / wave_n
+                out["surviving_share"] = len(parts_b) / N_PARTS
+                # failure detector: miss_threshold consecutive failed
+                # heartbeats declare hostA dead -> evict its member ->
+                # adopt its partitions -> replay its journals
+                await coord_b._heartbeat(peer_a)
+                await coord_b._heartbeat(peer_a)
+                out["peer_dead"] = peer_a.state == PEER_DEAD
+                out["hosts_live"] = int(METRICS.get("finchat_pod_hosts_live"))
+                out["adopted_all"] = (
+                    {p for _t, p in kb.assignment()} == parts_a | parts_b)
+                # exactly-once across the kill: the id host A answered and
+                # journaled is a DUPLICATE on the adopter
+                out["dedupe_inherited"] = ring_b.seen(f"mid-{pmig}")
+                # post-adoption wave: every partition has an owner again
+                aft_served = 0
+                for k in range(wave_n):
+                    conv = f"aft-{k}"
+                    _toks, e, _h = await turn(fleet_b, conv,
+                                              list(range(120 + k, 134 + k)),
+                                              n_new=6)
+                    aft_served += 1 if e is None else 0
+                out["goodput_after"] = aft_served / wave_n
+
+            results = {c: await asyncio.wait_for(t, timeout=300)
+                       for c, t in tasks.items()}
+            out["stream_tokens"] = {c: toks
+                                    for c, (toks, _e) in results.items()}
+            out["errors"] += sum(
+                1 for c, (_t, e) in results.items()
+                if e is not None and not (chaos and part_of(c) in parts_a))
+
+            # pmig turn 2: in the chaos run its partition now belongs to
+            # the adopter, whose admission resumes warm from the shared
+            # fabric record (host A's RAM died with it)
+            pm2_prompt = t1_prompt + out["pm1"] + [7, 8, 9]
+            out["pm2"], err, h = await turn(
+                fleet_b if chaos else fleet_a, pmig, pm2_prompt)
+            out["errors"] += 1 if err is not None else 0
+            out["pm2_resumed"] = h.resumed_len
+
+            for rep in (*fleet_a.replicas, *fleet_b.replicas):
+                rep.scheduler.allocator.check_invariants()
+        finally:
+            await fleet_a.stop()
+            await fleet_b.stop()
+            await coord_b.stop()
+            await coord_a.stop()
+            ja.close()
+            jb.close()
+            await asyncio.to_thread(fabric.tier.close)
+            faults.disarm_all()
+        return out
+
+    async def control(clean: dict) -> dict:
+        """Single host, pod attached but ZERO peers: the no-liaison
+        degradation — must be byte-identical to the plain fleet and
+        never move a pod counter."""
+        out: dict = {"errors": 0}
+        fleet = make_fleet("c", None)
+        solo = PodCoordinator(pod_cfg("solo"))
+        for rep in fleet.replicas:
+            rep.scheduler.pod = solo
+        try:
+            await fleet.start()
+            await solo.start()
+            pmig, lmig = clean["pmig"], clean["lmig"]
+            out["pm1"], err, _ = await turn(fleet, pmig, t1_prompt)
+            out["errors"] += 1 if err is not None else 0
+            out["lm1"], err, _ = await turn(fleet, lmig, t1_prompt)
+            out["errors"] += 1 if err is not None else 0
+            lm2_prompt = t1_prompt + out["lm1"] + [7, 8, 9]
+            out["lm2"], err, _ = await turn(fleet, lmig, lm2_prompt)
+            out["errors"] += 1 if err is not None else 0
+            handles = {}
+            for conv, prompt in clean["streams"].items():
+                rep = fleet.replica_for(conv)
+                handles[conv] = await rep.scheduler.submit(
+                    conv + "-s", prompt, greedy(10), conversation_id=conv)
+            results = {c: await drain(h) for c, h in handles.items()}
+            out["stream_tokens"] = {c: toks
+                                    for c, (toks, _e) in results.items()}
+            out["errors"] += sum(1 for _t, e in results.values()
+                                 if e is not None)
+            pm2_prompt = t1_prompt + out["pm1"] + [7, 8, 9]
+            out["pm2"], err, _ = await turn(fleet, pmig, pm2_prompt)
+            out["errors"] += 1 if err is not None else 0
+            for rep in fleet.replicas:
+                rep.scheduler.allocator.check_invariants()
+        finally:
+            await fleet.stop()
+            await solo.stop()
+        return out
+
+    pulls0 = METRICS.get("finchat_pod_session_pulls_total")
+    clean = asyncio.run(scenario(False, "clean"))
+    clean_pulls = int(METRICS.get("finchat_pod_session_pulls_total") - pulls0)
+
+    pulls0 = METRICS.get("finchat_pod_session_pulls_total")
+    adopt0 = METRICS.get("finchat_pod_partition_adoptions_total")
+    replay0 = METRICS.get("finchat_pod_adopted_ids_replayed_total")
+    death0 = METRICS.get("finchat_pod_peer_deaths_total")
+    t0 = time.perf_counter()
+    chaos = asyncio.run(scenario(True, "chaos"))
+    wall = time.perf_counter() - t0
+    chaos_pulls = int(METRICS.get("finchat_pod_session_pulls_total") - pulls0)
+    adoptions = int(METRICS.get("finchat_pod_partition_adoptions_total") - adopt0)
+    replayed = int(METRICS.get("finchat_pod_adopted_ids_replayed_total") - replay0)
+    deaths = int(METRICS.get("finchat_pod_peer_deaths_total") - death0)
+
+    pod_counters = (
+        "finchat_pod_session_pulls_total", "finchat_pod_pull_misses_total",
+        "finchat_pod_heartbeats_total", "finchat_pod_peer_deaths_total",
+    )
+    ctr0 = {m: METRICS.get(m) for m in pod_counters}
+    control_out = asyncio.run(control(clean))
+    pod_silent = all(METRICS.get(m) == ctr0[m] for m in pod_counters)
+
+    migrated_identical = (
+        chaos["pm2"] == clean["pm2"] and chaos["lm2"] == clean["lm2"]
+        and chaos["stream_tokens"] == clean["stream_tokens"]
+    )
+    control_identical = (
+        control_out["pm2"] == clean["pm2"]
+        and control_out["lm2"] == clean["lm2"]
+        and control_out["stream_tokens"] == clean["stream_tokens"]
+    )
+    goodput_floor_ok = (
+        chaos.get("goodput_during", 0.0) >= chaos.get("surviving_share", 1.0))
+    print(f"[bench] pod kill-a-host: errors={chaos['errors']} "
+          f"peer_dead={chaos.get('peer_dead')} adopted_all={chaos.get('adopted_all')} "
+          f"adoptions={adoptions} replayed={replayed} deaths={deaths}",
+          file=sys.stderr, flush=True)
+    print(f"[bench] pod goodput: during={chaos.get('goodput_during')} "
+          f"(share={chaos.get('surviving_share')}) "
+          f"after={chaos.get('goodput_after')} hosts_live={chaos.get('hosts_live')}",
+          file=sys.stderr, flush=True)
+    print(f"[bench] pod migration: fabric_resumed={chaos.get('pm2_resumed')} "
+          f"liaison_resumed={chaos.get('lm2_resumed')} "
+          f"pulls clean={clean_pulls} chaos={chaos_pulls} "
+          f"identical={migrated_identical} control_identical={control_identical} "
+          f"dedupe_inherited={chaos.get('dedupe_inherited')}",
+          file=sys.stderr, flush=True)
+
+    return {
+        "metric": "pod_sweep",
+        "unit": "goodput, adopted partitions, replayed ids",
+        "smoke": smoke,
+        "hosts": 2,
+        "replicas_per_host": 2,
+        "partitions": N_PARTS,
+        "model": "tiny (fp32 — identity contract, see measure_fleet_sweep)",
+        # acceptance gates (tier1.yml --pod-smoke; ISSUE 20)
+        "streams_survive_kill": chaos["errors"] == 0,
+        "migrated_outputs_identical": migrated_identical,
+        "peer_dead_detected": bool(chaos.get("peer_dead")),
+        "adopted_all_partitions": bool(chaos.get("adopted_all")),
+        "partition_adoptions": adoptions,
+        "adopted_ids_replayed": replayed,
+        "dedupe_inherited": bool(chaos.get("dedupe_inherited")),
+        "goodput_during": chaos.get("goodput_during"),
+        "surviving_share": chaos.get("surviving_share"),
+        "goodput_floor_ok": goodput_floor_ok,
+        "goodput_after": chaos.get("goodput_after"),
+        "hosts_live_after_kill": chaos.get("hosts_live"),
+        "fabric_resumed_len": int(chaos.get("pm2_resumed", 0)),
+        "liaison_resumed_len": int(chaos.get("lm2_resumed", 0)),
+        "session_pulls_clean": clean_pulls,
+        "session_pulls_chaos": chaos_pulls,
+        "control_identical": control_identical,
+        "control_pod_plane_silent": pod_silent,
+        "control_errors": control_out["errors"],
+        "wall_s": round(wall, 2),
+        "platform": jax.devices()[0].platform,
+        "device": str(jax.devices()[0]),
+    }
+
+
 def measure_disagg_sweep(smoke: bool = False) -> dict:
     """Disaggregated prefill/decode + warm-fabric drill (ISSUE 17),
     CPU-runnable through REAL schedulers on the tiny fp32 config.
@@ -4103,6 +4556,8 @@ def spawn_worker(args: argparse.Namespace, platform: str, timeout: float) -> dic
     if args.fleet_sweep or args.fleet_smoke:
         cmd += ["--fleet-replicas", str(args.fleet_replicas)]
         cmd += ["--fleet-smoke"] if args.fleet_smoke else ["--fleet-sweep"]
+    if args.pod_sweep or args.pod_smoke:
+        cmd += ["--pod-smoke"] if args.pod_smoke else ["--pod-sweep"]
     if args.disagg_sweep or args.disagg_smoke:
         cmd += (["--disagg-smoke"] if args.disagg_smoke
                 else ["--disagg-sweep"])

@@ -28,6 +28,9 @@ Blocking primitives:
 - device-rebuild entry points (``rebuild_device_state``)
 - executor joins (``....submit(...).result()``)
 - blocking file opens (builtin ``open``)
+- blocking socket I/O (``socket.create_connection`` and the
+  ``.recv()`` / ``.sendall()`` / ``.accept()`` method tails) — the pod
+  liaison must use asyncio streams or an off-loop worker
 
 Allowlist (the blessed off-loop seams, per STATIC_ANALYSIS.md): the
 session disk tier's writer-thread bodies — reachable inline only in the
@@ -58,6 +61,9 @@ BLOCKING_DOTTED = {
     "os.fdatasync": "os.fdatasync",
     "os.sync": "os.sync",
     "jax.block_until_ready": "jax.block_until_ready",
+    # blocking socket dial (ISSUE 20: the pod liaison must be asyncio
+    # streams or an off-loop worker, like every other I/O seam)
+    "socket.create_connection": "socket.create_connection",
 }
 
 # attribute tails that block regardless of receiver type
@@ -65,6 +71,14 @@ BLOCKING_METHODS = {
     "block_until_ready": "device sync (.block_until_ready)",
     "rebuild_device_state": "device-state rebuild (seconds of device work)",
     "fsync": "fsync",
+    # blocking socket I/O (ISSUE 20): a liaison channel built on raw
+    # sockets would stall every in-flight stream for a peer's RTT — the
+    # asyncio-streams transport in serve/pod.py is the blessed path.
+    # These tails are socket-specific by convention in this codebase
+    # (asyncio writers use write/drain, never sendall/recv/accept).
+    "recv": "blocking socket `.recv()`",
+    "sendall": "blocking socket `.sendall()`",
+    "accept": "blocking socket `.accept()`",
 }
 
 # blessed off-loop seams: traversal never descends into (or reports

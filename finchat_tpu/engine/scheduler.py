@@ -569,6 +569,10 @@ class ContinuousBatchingScheduler:
         # attaches its DisaggCoordinator to SERVING-pool schedulers only;
         # submit routes cold prompt prefills through it when set
         self.disagg = None
+        # pod plane (serve/pod.py — ISSUE 20): the app attaches its
+        # PodCoordinator; submit asks it to pull a conversation's session
+        # bytes from a liaison peer when nothing local can resume it warm
+        self.pod = None
         if fabric is not None:
             # fabric accounting is per calling replica (R5: pre-seeded so
             # the zero state is visible): hits/misses at head registration
@@ -711,6 +715,17 @@ class ContinuousBatchingScheduler:
                              conversation_id, e)
                 self.metrics.inc("finchat_disagg_fallbacks_total",
                                  labels={"reason": "prefill_error"})
+        if self.pod is not None and conversation_id:
+            # pod plane (ISSUE 20): a conversation inherited from another
+            # host pulls its newest session record over the liaison BEFORE
+            # admission, so the match below resumes from it warm. Every
+            # failure inside is a counted cold start, never an error here.
+            try:
+                await self.pod.maybe_pull(self, conversation_id,
+                                          trace_id=trace_id)
+            except Exception as e:
+                logger.error("pod session pull for %s failed: %s",
+                             conversation_id, e)
         handle = SequenceHandle(
             seq_id=seq_id, prompt_ids=list(prompt_ids), sampling=sampling,
             constraint=constraint, conversation_id=conversation_id,

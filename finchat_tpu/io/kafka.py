@@ -246,13 +246,30 @@ class InMemoryBroker:
                 group.members.remove(member_id)
                 group.subscriptions.pop(member_id, None)
 
+    def evict_member(self, group_id: str, member_id: str) -> None:
+        """Kick a dead member out of the group — what a real broker does
+        itself when a consumer misses ``session.timeout.ms`` heartbeats.
+        The memory broker has no timer, so the pod layer (serve/pod.py)
+        drives this from ITS heartbeat verdict: a host declared dead is
+        evicted here and the next poll of every survivor sees the
+        rebalanced assignment (the dead host's partitions round-robin onto
+        the remaining members; a rejoin restores the exact mapping since
+        assignment is positional over the member list)."""
+        self.leave_group(group_id, member_id)
+
     def _assignment(self, group: _GroupState, member_id: str, topics: list[str]) -> list[tuple[str, int]]:
         """Round-robin partition assignment, per topic, among the members
         actually subscribed to that topic (so mixed-subscription groups
-        leave no partition orphaned)."""
+        leave no partition orphaned). Positions are taken over the SORTED
+        member ids, not join order, so the mapping is a pure function of
+        the member set — a host that drops out and rejoins under its old
+        member id gets back exactly the partitions it had (the pod
+        layer's rejoin contract, serve/pod.py)."""
         out = []
         for topic in topics:
-            subscribers = [m for m in group.members if topic in group.subscriptions.get(m, ())]
+            subscribers = sorted(
+                m for m in group.members if topic in group.subscriptions.get(m, ())
+            )
             if member_id not in subscribers:
                 continue
             idx = subscribers.index(member_id)
@@ -419,6 +436,35 @@ class KafkaClient:
         except Exception as e:
             logger.error("Error in message consumption: %s", e)
             return None
+
+    @property
+    def member_id(self) -> str:
+        """This consumer's group-member id — the unit the broker assigns
+        partitions to and the handle a pod-layer eviction removes. One
+        host's App is one member; its partition share IS its routing
+        share (routing ≡ assignment)."""
+        return self._member_id
+
+    def assignment(self) -> list[tuple[str, int]]:
+        """The (topic, partition) pairs currently assigned to THIS member
+        — the pod coordinator diffs this across a rebalance to find the
+        partitions a host just inherited (and therefore which per-
+        partition journals to replay into its dedupe ring). Empty before
+        ``setup_consumer`` and, on the confluent backend, until the first
+        poll completes the group join."""
+        if not self._consumer_ready:
+            return []
+        if self._broker is not None:
+            with self._broker._lock:
+                group = self._broker._groups.get(GROUP_ID)
+                if group is None:
+                    return []
+                return self._broker._assignment(group, self._member_id,
+                                                self._topics)
+        if self._consumer is not None:  # pragma: no cover - needs librdkafka
+            return [(tp.topic, tp.partition)
+                    for tp in self._consumer.assignment()]
+        return []
 
     @property
     def num_partitions(self) -> int:

@@ -481,12 +481,33 @@ class App:
                 self._journal = AnsweredJournal(
                     cfg.journal.path, fsync=cfg.journal.fsync,
                     keep=self._dedupe.size,
+                    num_partitions=getattr(kafka, "num_partitions", 1),
                 )
                 self._dedupe.preload(self._journal.replay())
             except Exception as e:  # durability is best-effort
                 logger.error("answered journal unavailable at %s: %s",
                              cfg.journal.path, e)
                 self._journal = None
+        # pod plane (serve/pod.py — ISSUE 20): with pod.host_id set, this
+        # process is one HOST of a multi-host pod — liaison heartbeats to
+        # the peer table, partition adoption (with per-partition journal
+        # replay into the shared dedupe ring) on a peer's death, and
+        # cross-host session pulls before admission. Off = bit-identical
+        # to the plain fleet.
+        self.pod = None
+        if cfg.pod.host_id:
+            from finchat_tpu.serve.pod import PodCoordinator
+
+            try:
+                self.pod = PodCoordinator(
+                    cfg.pod, fleet=fleet, kafka=kafka,
+                    journal=self._journal, dedupe=self._dedupe,
+                )
+                for sched in self._all_schedulers():
+                    sched.pod = self.pod
+            except Exception as e:  # the pod plane is best-effort too
+                logger.error("pod plane unavailable: %s", e)
+                self.pod = None
         # graceful SIGTERM drain (ISSUE 7): set while drain_and_stop runs
         # so the HTTP chat paths stop admitting with a retryable 503
         self._draining = False
@@ -527,6 +548,10 @@ class App:
             if self._on_engine_rebuild not in self.scheduler.on_rebuild:
                 self.scheduler.on_rebuild.append(self._on_engine_rebuild)
             await self.scheduler.start()
+        if self.pod is not None:
+            # after setup_consumer: the coordinator snapshots this host's
+            # REAL partition assignment as its adoption baseline
+            await self.pod.start()
         self._running = True
         self._consume_task = asyncio.create_task(self.consume_messages())
         if self._prefix_cache_enabled:
@@ -555,6 +580,8 @@ class App:
         batcher = self._embed_batcher()
         if batcher is not None:
             await batcher.close()
+        if self.pod is not None:
+            await self.pod.stop()
         if self.fleet is not None:
             await self.fleet.stop()
         elif self.scheduler is not None:
@@ -1198,11 +1225,13 @@ class App:
                 # is reprocessed instead of black-holed
                 self._dedupe.forget(mid)
             elif mid is not None and self._journal is not None:
-                # ANSWERED: journal the id — fsync completes BEFORE the
-                # watermark commit below, so a crash between them
-                # redelivers the message to a process that already knows
-                # it was answered (ISSUE 7; ROBUSTNESS.md §5)
-                self._journal.append(mid)
+                # ANSWERED: journal the id under the message's PARTITION —
+                # fsync completes BEFORE the watermark commit below, so a
+                # crash between them redelivers the message to a process
+                # that already knows it was answered (ISSUE 7; §5), and a
+                # host that ADOPTS this partition replays exactly this
+                # file into its ring (ISSUE 20; §7)
+                self._journal.append(mid, partition=msg.partition())
             # the watchdog-wrapped handler completed (answered, errored, or
             # timed out with the timeout chunk emitted): only now may this
             # offset count toward the committed watermark

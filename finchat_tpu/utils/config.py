@@ -496,6 +496,47 @@ class JournalConfig:
 
 
 @dataclass
+class PodConfig:
+    """Multi-host pod plane (serve/pod.py — ISSUE 20; ROBUSTNESS.md §7).
+
+    With ``host_id`` set, this process is one HOST of a pod: its fleet is
+    one failure domain, its Kafka consumer-group member owns a partition
+    share (routing ≡ assignment), and a ``PodCoordinator`` runs a liaison
+    channel to the peers — heartbeat for failure detection, session-byte
+    transfer for cross-host warm resume. On a peer's death the survivors
+    adopt its partitions (broker rebalance), replay exactly the inherited
+    per-partition journals into the dedupe ring, and resume the dead
+    host's conversations via the warm fabric or a liaison pull. Empty
+    ``host_id`` = the plane entirely off: single-host behavior is
+    bit-identical to the plain fleet.
+    """
+
+    host_id: str = ""  # this host's name in the pod; "" = pod plane off
+    # peer table: "hostB=tcp:127.0.0.1:9710,hostC=inproc:hostC" — transport
+    # is tcp:<host>:<port> or inproc:<name> (in-process registry, the
+    # simulated-pod/test transport). "" = no liaison: heartbeat/transfer
+    # off, fabric-or-cold resume only.
+    peers: str = ""
+    # this host's liaison listen address (same tcp:/inproc: syntax); "" =
+    # serve nothing (peers can still be dialed)
+    listen: str = ""
+    heartbeat_interval_seconds: float = 0.5
+    # consecutive missed heartbeats before a peer is declared dead and its
+    # partitions adopted
+    heartbeat_miss_threshold: int = 3
+    transfer_timeout_seconds: float = 5.0
+    # per-op retries on top of the first attempt (transfer only; a missed
+    # heartbeat is itself the signal and never retries inline)
+    transfer_retries: int = 2
+    retry_backoff_seconds: float = 0.05
+    # per-peer circuit breaker: consecutive liaison failures before the
+    # peer's channel opens (calls fail fast), and how long until a
+    # half-open probe is allowed through
+    breaker_threshold: int = 3
+    breaker_cooldown_seconds: float = 2.0
+
+
+@dataclass
 class ShutdownConfig:
     """Graceful SIGTERM drain (serve/app.py drain_and_stop — ISSUE 7)."""
 
@@ -547,6 +588,7 @@ class AppConfig:
     fleet: FleetConfig = field(default_factory=FleetConfig)
     fabric: FabricConfig = field(default_factory=FabricConfig)
     journal: JournalConfig = field(default_factory=JournalConfig)
+    pod: PodConfig = field(default_factory=PodConfig)
     shutdown: ShutdownConfig = field(default_factory=ShutdownConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
@@ -694,6 +736,34 @@ def load_config(
     )
     cfg.journal.path = _env("FINCHAT_JOURNAL_PATH", cfg.journal.path)
     cfg.journal.fsync = _env_bool("FINCHAT_JOURNAL_FSYNC", cfg.journal.fsync)
+    cfg.pod.host_id = _env("FINCHAT_POD_HOST_ID", cfg.pod.host_id)
+    cfg.pod.peers = _env("FINCHAT_POD_PEERS", cfg.pod.peers)
+    cfg.pod.listen = _env("FINCHAT_POD_LISTEN", cfg.pod.listen)
+    cfg.pod.heartbeat_interval_seconds = _env_float(
+        "FINCHAT_POD_HEARTBEAT_INTERVAL_SECONDS",
+        cfg.pod.heartbeat_interval_seconds,
+    )
+    cfg.pod.heartbeat_miss_threshold = _env_int(
+        "FINCHAT_POD_HEARTBEAT_MISS_THRESHOLD",
+        cfg.pod.heartbeat_miss_threshold,
+    )
+    cfg.pod.transfer_timeout_seconds = _env_float(
+        "FINCHAT_POD_TRANSFER_TIMEOUT_SECONDS",
+        cfg.pod.transfer_timeout_seconds,
+    )
+    cfg.pod.transfer_retries = _env_int(
+        "FINCHAT_POD_TRANSFER_RETRIES", cfg.pod.transfer_retries
+    )
+    cfg.pod.retry_backoff_seconds = _env_float(
+        "FINCHAT_POD_RETRY_BACKOFF_SECONDS", cfg.pod.retry_backoff_seconds
+    )
+    cfg.pod.breaker_threshold = _env_int(
+        "FINCHAT_POD_BREAKER_THRESHOLD", cfg.pod.breaker_threshold
+    )
+    cfg.pod.breaker_cooldown_seconds = _env_float(
+        "FINCHAT_POD_BREAKER_COOLDOWN_SECONDS",
+        cfg.pod.breaker_cooldown_seconds,
+    )
     cfg.shutdown.deadline_seconds = _env_float(
         "FINCHAT_SHUTDOWN_DEADLINE_SECONDS", cfg.shutdown.deadline_seconds
     )

@@ -161,6 +161,24 @@ against the cluster-wide fabric, counted on the requesting replica),
 mode mismatched the engine — cold prefill instead), and the
 ``finchat_fabric_restore_seconds`` histogram (fabric record → device KV,
 covering both shared-head restores and session resumes).
+
+Pod family (serve/pod.py — ISSUE 20; host-level, emitted unlabeled on
+the global registry — one host process is one reader):
+``finchat_pod_hosts_live`` (gauge — this host plus LIVE peers),
+``finchat_pod_heartbeats_total`` / ``finchat_pod_heartbeat_failures_
+total`` (liaison pings), ``finchat_pod_peer_deaths_total`` /
+``finchat_pod_peer_rejoins_total`` (failure-detector verdicts),
+``finchat_pod_partition_adoptions_total`` (partitions inherited across
+rebalances) + ``finchat_pod_adopted_ids_replayed_total`` (answered ids
+replayed from inherited per-partition journals into the dedupe ring),
+``finchat_pod_session_pulls_total`` / ``finchat_pod_pull_misses_total``
+(cross-host session transfers; misses are peers that had nothing),
+``finchat_pod_breaker_trips_total`` (per-peer liaison circuit breaker),
+``finchat_pod_cold_starts_total{reason=breaker_open|peer_unreachable|
+transfer_corrupt|import_refused}`` (pod-path failures that fell back to
+a cold start — pre-seeded at zero; never a user error), and the
+``finchat_pod_transfer_seconds`` histogram (pull request → record
+imported).
 """
 
 from __future__ import annotations

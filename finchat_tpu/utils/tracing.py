@@ -96,6 +96,13 @@ TRACE_EVENTS = frozenset({
     # served from the cluster-wide fabric instead of a local prefill —
     # args.kind distinguishes "head" from "session"
     "fabric_hit",
+    # pod plane (ISSUE 20): a survivor adopted a dead host's partitions —
+    # args carry the dead host, the inherited partitions, and how many
+    # journaled ids replayed into the dedupe ring
+    "pod_adopt",
+    # pod plane (ISSUE 20): a conversation's session bytes were pulled
+    # from a liaison peer and imported warm — args carry peer and bytes
+    "pod_session_pull",
 })
 
 #: Anomaly kinds — each records an event AND triggers a flight dump.
@@ -106,6 +113,10 @@ ANOMALY_KINDS = frozenset({
     # staged descriptor plan never armed a row (ISSUE 13) — the drain
     # refuses the unarmed cells and dumps the black box
     "freerun_divergence",
+    # pod plane (ISSUE 20): a liaison peer missed enough heartbeats to be
+    # declared dead — the host failure domain tripped; partition adoption
+    # follows
+    "pod_host_lost",
 })
 
 TRACE_EVENT_NAMES = SPAN_MARKS | TRACE_EVENTS | ANOMALY_KINDS

@@ -68,6 +68,7 @@ SANITIZED_MODULES = {
     "test_prefix_cache",
     "test_spec_decode",
     "test_bounded_kv",
+    "test_pod",
 }
 
 _SANITIZERS_ON = os.environ.get("FINCHAT_STALL_SANITIZER", "1") not in ("0", "false")

@@ -172,6 +172,50 @@ def test_r1_off_loop_lambda_and_thread_args_are_exempt(tmp_path):
     assert res.findings == []
 
 
+def test_r1_blocking_socket_liaison_is_flagged(tmp_path):
+    """A pod liaison built on raw sockets stalls every in-flight stream
+    for a peer's RTT: create_connection / sendall / recv / accept on an
+    async path are all primitives."""
+    src = """
+        import socket
+
+        class Liaison:
+            async def call(self, addr, frame):
+                conn = socket.create_connection(addr)
+                conn.sendall(frame)
+                return conn.recv(65536)
+
+            async def serve(self, srv):
+                conn, _peer = srv.accept()
+                return conn
+    """
+    res = _lint(tmp_path, {"liaison.py": src}, {"event-loop-blocking"})
+    msgs = " | ".join(_messages(res))
+    assert "socket.create_connection" in msgs
+    assert ".sendall()" in msgs and ".recv()" in msgs and ".accept()" in msgs
+    assert len(res.findings) == 4
+
+
+def test_r1_asyncio_stream_liaison_is_clean(tmp_path):
+    """The blessed transport (serve/pod.py): asyncio streams — awaited
+    open_connection / readexactly / write+drain never hit the socket
+    primitives."""
+    src = """
+        import asyncio
+
+        class Liaison:
+            async def call(self, host, port, frame):
+                reader, writer = await asyncio.open_connection(host, port)
+                writer.write(frame)
+                await writer.drain()
+                raw = await reader.readexactly(9)
+                writer.close()
+                return raw
+    """
+    res = _lint(tmp_path, {"liaison.py": src}, {"event-loop-blocking"})
+    assert res.findings == []
+
+
 # ---------------------------------------------------------------------------
 # R2 hot-path-host-sync
 # ---------------------------------------------------------------------------

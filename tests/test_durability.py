@@ -418,7 +418,7 @@ def test_journal_replay_compacts_and_skips_corrupt_records(tmp_path):
         journal.append(mid)
     journal.close()
     # torn tail (crash mid-append) + a corrupt middle record
-    with open(journal.path, "r+b") as f:
+    with open(journal._part_path(0), "r+b") as f:
         raw = f.read()
         lines = raw.split(b"\n")
         lines[1] = b"v1 00000000 " + lines[1].split(b" ", 2)[2]  # bad crc
@@ -470,7 +470,7 @@ async def test_answered_id_journaled_before_commit_and_replayed(tmp_path):
     committed = []
     app.kafka.commit_offset = (
         lambda t, p, n: committed.append(
-            (tmp_path / "journal" / "answered.journal").read_bytes()
+            (tmp_path / "journal" / "answered-p0000.journal").read_bytes()
         )
     )
     payload = {"message": "How am I doing?", "conversation_id": "c1",
